@@ -1,0 +1,40 @@
+"""lightgbm_tpu — a TPU-native gradient boosting framework.
+
+A from-scratch reimplementation of the capabilities of LightGBM (reference:
+sky-noodle/LightGBM, mirrored read-only at /root/reference) designed for TPU
+hardware: binned feature matrices live in HBM as dense device arrays,
+histograms are built by XLA/Pallas kernels (one-hot matmul onto the MXU),
+split finding is a vectorized prefix-scan, tree growth is a single jitted
+`lax.fori_loop`, and distributed training uses `jax.sharding.Mesh` +
+`shard_map` with XLA collectives (psum / all_gather / reduce_scatter) over
+ICI/DCN in place of the reference's socket/MPI Network layer.
+
+Public API mirrors the reference python-package (python-package/lightgbm):
+`Dataset`, `Booster`, `train`, `cv`, sklearn wrappers, callbacks, plotting.
+"""
+
+__version__ = "0.1.0"
+
+from .basic import Booster, Dataset
+from .engine import cv, train
+from .callback import early_stopping, log_evaluation, record_evaluation, reset_parameter
+
+try:  # sklearn wrappers are optional (sklearn is present in CI images)
+    from .sklearn import LGBMModel, LGBMRegressor, LGBMClassifier, LGBMRanker
+except ImportError:  # pragma: no cover
+    pass
+
+__all__ = [
+    "Dataset",
+    "Booster",
+    "train",
+    "cv",
+    "LGBMModel",
+    "LGBMRegressor",
+    "LGBMClassifier",
+    "LGBMRanker",
+    "early_stopping",
+    "log_evaluation",
+    "record_evaluation",
+    "reset_parameter",
+]

@@ -1,0 +1,7 @@
+"""Placeholder — implemented in a later milestone."""
+class Dataset:
+    pass
+
+
+class Booster:
+    pass

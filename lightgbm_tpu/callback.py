@@ -1,0 +1,15 @@
+"""Placeholder — implemented in a later milestone."""
+def early_stopping(*a, **k):
+    raise NotImplementedError
+
+
+def log_evaluation(*a, **k):
+    raise NotImplementedError
+
+
+def record_evaluation(*a, **k):
+    raise NotImplementedError
+
+
+def reset_parameter(*a, **k):
+    raise NotImplementedError

@@ -1,0 +1,353 @@
+"""Parameter handling — the counterpart of the reference's config layer
+(include/LightGBM/config.h, src/io/config.cpp).
+
+The reference splits parameters into nested sub-config structs
+(IOConfig/TreeConfig/BoostingConfig/ObjectiveConfig/MetricConfig/
+NetworkConfig wired into OverallConfig).  Here a single flat dataclass holds
+every parameter under its canonical name — the layering in the reference is
+an artifact of C++ struct ownership, not semantics — while the alias table
+(config.h:359–487) and the unknown-parameter rejection are reproduced
+exactly so that `lgb.train(params=...)` dicts written for the reference work
+unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .utils.log import Log
+
+# Alias -> canonical name. Parity with config.h:361-443.
+PARAM_ALIASES: Dict[str, str] = {
+    "config": "config_file",
+    "nthread": "num_threads",
+    "random_seed": "seed",
+    "num_thread": "num_threads",
+    "boosting": "boosting_type",
+    "boost": "boosting_type",
+    "application": "objective",
+    "app": "objective",
+    "train_data": "data",
+    "train": "data",
+    "model_output": "output_model",
+    "model_out": "output_model",
+    "model_input": "input_model",
+    "model_in": "input_model",
+    "predict_result": "output_result",
+    "prediction_result": "output_result",
+    "valid": "valid_data",
+    "test_data": "valid_data",
+    "test": "valid_data",
+    "is_sparse": "is_enable_sparse",
+    "enable_sparse": "is_enable_sparse",
+    "pre_partition": "is_pre_partition",
+    "tranining_metric": "is_training_metric",
+    "train_metric": "is_training_metric",
+    "ndcg_at": "ndcg_eval_at",
+    "eval_at": "ndcg_eval_at",
+    "min_data_per_leaf": "min_data_in_leaf",
+    "min_data": "min_data_in_leaf",
+    "min_child_samples": "min_data_in_leaf",
+    "min_sum_hessian_per_leaf": "min_sum_hessian_in_leaf",
+    "min_sum_hessian": "min_sum_hessian_in_leaf",
+    "min_hessian": "min_sum_hessian_in_leaf",
+    "min_child_weight": "min_sum_hessian_in_leaf",
+    "num_leaf": "num_leaves",
+    "sub_feature": "feature_fraction",
+    "colsample_bytree": "feature_fraction",
+    "num_iteration": "num_iterations",
+    "num_tree": "num_iterations",
+    "num_round": "num_iterations",
+    "num_trees": "num_iterations",
+    "num_rounds": "num_iterations",
+    "num_boost_round": "num_iterations",
+    "sub_row": "bagging_fraction",
+    "subsample": "bagging_fraction",
+    "subsample_freq": "bagging_freq",
+    "shrinkage_rate": "learning_rate",
+    "tree": "tree_learner",
+    "num_machine": "num_machines",
+    "local_port": "local_listen_port",
+    "two_round_loading": "use_two_round_loading",
+    "two_round": "use_two_round_loading",
+    "mlist": "machine_list_file",
+    "is_save_binary": "is_save_binary_file",
+    "save_binary": "is_save_binary_file",
+    "early_stopping_rounds": "early_stopping_round",
+    "early_stopping": "early_stopping_round",
+    "verbosity": "verbose",
+    "header": "has_header",
+    "label": "label_column",
+    "weight": "weight_column",
+    "group": "group_column",
+    "query": "group_column",
+    "query_column": "group_column",
+    "ignore_feature": "ignore_column",
+    "blacklist": "ignore_column",
+    "categorical_feature": "categorical_column",
+    "cat_column": "categorical_column",
+    "cat_feature": "categorical_column",
+    "predict_raw_score": "is_predict_raw_score",
+    "predict_leaf_index": "is_predict_leaf_index",
+    "raw_score": "is_predict_raw_score",
+    "leaf_index": "is_predict_leaf_index",
+    "min_split_gain": "min_gain_to_split",
+    "topk": "top_k",
+    "reg_alpha": "lambda_l1",
+    "reg_lambda": "lambda_l2",
+    "num_classes": "num_class",
+    "unbalanced_sets": "is_unbalance",
+    "bagging_fraction_seed": "bagging_seed",
+}
+
+
+@dataclass
+class Config:
+    """All canonical parameters with reference defaults (config.h:85–290)."""
+
+    # --- task / global (OverallConfig)
+    task: str = "train"
+    seed: int = 0
+    num_threads: int = 0
+    boosting_type: str = "gbdt"
+    objective: str = "regression"
+    metric: List[str] = field(default_factory=list)
+    tree_learner: str = "serial"
+    device: str = "tpu"  # reference default "cpu"; here TPU is the device story
+    config_file: str = ""
+    convert_model_language: str = ""
+
+    # --- IO (IOConfig, config.h:87–148)
+    max_bin: int = 255
+    num_class: int = 1
+    data_random_seed: int = 1
+    data: str = ""
+    valid_data: List[str] = field(default_factory=list)
+    snapshot_freq: int = 100
+    output_model: str = "LightGBM_model.txt"
+    output_result: str = "LightGBM_predict_result.txt"
+    convert_model: str = "gbdt_prediction.cpp"
+    input_model: str = ""
+    verbose: int = 1
+    num_iteration_predict: int = -1
+    is_pre_partition: bool = False
+    is_enable_sparse: bool = True
+    sparse_threshold: float = 0.8
+    use_two_round_loading: bool = False
+    is_save_binary_file: bool = False
+    enable_load_from_binary_file: bool = True
+    bin_construct_sample_cnt: int = 200000
+    is_predict_leaf_index: bool = False
+    is_predict_raw_score: bool = False
+    min_data_in_bin: int = 5
+    max_conflict_rate: float = 0.0
+    enable_bundle: bool = True
+    has_header: bool = False
+    label_column: str = ""
+    weight_column: str = ""
+    group_column: str = ""
+    ignore_column: str = ""
+    categorical_column: str = ""
+    pred_early_stop: bool = False
+    pred_early_stop_freq: int = 10
+    pred_early_stop_margin: float = 10.0
+
+    # --- tree (TreeConfig, config.h:189–234)
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    num_leaves: int = 31
+    feature_fraction_seed: int = 2
+    feature_fraction: float = 1.0
+    histogram_pool_size: float = -1.0
+    max_depth: int = -1
+    top_k: int = 20
+    gpu_platform_id: int = -1
+    gpu_device_id: int = -1
+    gpu_use_dp: bool = False
+    use_missing: bool = True
+
+    # --- boosting (BoostingConfig, config.h:236–266)
+    output_freq: int = 1
+    is_training_metric: bool = False
+    num_iterations: int = 100
+    learning_rate: float = 0.1
+    bagging_fraction: float = 1.0
+    bagging_seed: int = 3
+    bagging_freq: int = 0
+    early_stopping_round: int = 0
+    drop_rate: float = 0.1
+    max_drop: int = 50
+    skip_drop: float = 0.5
+    xgboost_dart_mode: bool = False
+    uniform_drop: bool = False
+    drop_seed: int = 4
+    top_rate: float = 0.2
+    other_rate: float = 0.1
+    boost_from_average: bool = True
+
+    # --- objective (ObjectiveConfig, config.h:153–172)
+    sigmoid: float = 1.0
+    huber_delta: float = 1.0
+    fair_c: float = 1.0
+    gaussian_eta: float = 1.0
+    poisson_max_delta_step: float = 0.7
+    label_gain: List[float] = field(default_factory=list)
+    max_position: int = 20
+    is_unbalance: bool = False
+    scale_pos_weight: float = 1.0
+
+    # --- metric (MetricConfig, config.h:176–186)
+    ndcg_eval_at: List[int] = field(default_factory=lambda: [1, 2, 3, 4, 5])
+    metric_freq: int = 1
+
+    # --- network (NetworkConfig, config.h:261–268)
+    num_machines: int = 1
+    local_listen_port: int = 12400
+    time_out: int = 120
+    machine_list_file: str = ""
+
+    # --- derived
+    is_parallel: bool = False
+    is_parallel_find_bin: bool = False
+
+    def copy(self) -> "Config":
+        return dataclasses.replace(self)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_params(cls, params: Optional[Dict[str, Any]]) -> "Config":
+        cfg = cls()
+        cfg.update(params or {})
+        return cfg
+
+    def update(self, params: Dict[str, Any]) -> None:
+        canon = canonicalize_params(params)
+        for key, value in canon.items():
+            self._set_one(key, value)
+        self._check_conflicts()
+
+    def _set_one(self, key: str, value: Any) -> None:
+        if key in ("metric",):
+            self.metric = _parse_list(value, str)
+            return
+        if key in ("valid_data",):
+            self.valid_data = _parse_list(value, str)
+            return
+        if key == "ndcg_eval_at":
+            self.ndcg_eval_at = _parse_list(value, int)
+            return
+        if key == "label_gain":
+            self.label_gain = _parse_list(value, float)
+            return
+        if not hasattr(self, key):
+            Log.fatal("Unknown parameter: %s", key)
+        cur = getattr(self, key)
+        try:
+            if isinstance(cur, bool):
+                setattr(self, key, _parse_bool(key, value))
+            elif isinstance(cur, int):
+                setattr(self, key, int(value))
+            elif isinstance(cur, float):
+                setattr(self, key, float(value))
+            else:
+                setattr(self, key, str(value))
+        except (TypeError, ValueError):
+            Log.fatal("Parameter %s received an unparsable value \"%s\"", key, value)
+
+    def _check_conflicts(self) -> None:
+        """CheckParamConflict (config.cpp): parallel learners imply
+        is_parallel; bagging requires fraction<1 and freq>0; etc."""
+        learner = self.tree_learner.lower()
+        if learner in ("feature", "data", "voting") and self.num_machines > 1:
+            self.is_parallel = True
+        else:
+            self.is_parallel = False
+        if learner == "data" or learner == "voting":
+            self.is_parallel_find_bin = self.is_parallel
+        if self.num_leaves < 2:
+            Log.fatal("num_leaves must be >= 2, got %d", self.num_leaves)
+        if not (0.0 < self.feature_fraction <= 1.0):
+            Log.fatal("feature_fraction must be in (0, 1], got %s", self.feature_fraction)
+        if not (0.0 < self.bagging_fraction <= 1.0):
+            Log.fatal("bagging_fraction must be in (0, 1], got %s", self.bagging_fraction)
+        Log.reset_level(self.verbose)
+
+
+# canonical parameter names beyond the alias table; mirrors the
+# parameter_set whitelist at config.h:444-474 (extended with TPU-specific
+# names; unknown keys are rejected like the reference's Log::Fatal).
+_EXTRA_ALLOWED = {
+    "machine_list_filename",
+    "data_filename",
+    "valid_data_filenames",
+    "poission_max_delta_step",  # reference's own typo, kept accepted
+    "is_provide_training_metric",
+}
+
+
+def canonicalize_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Alias resolution with canonical-name priority: an explicitly-passed
+    canonical key wins over a value arriving via an alias
+    (ParameterAlias::KeyAliasTransform, config.h:475-486)."""
+    cfg_fields = {f.name for f in dataclasses.fields(Config)}
+    out: Dict[str, Any] = {}
+    aliased: Dict[str, Any] = {}
+    for key, value in params.items():
+        if value is None:
+            continue
+        if key in PARAM_ALIASES:
+            aliased[PARAM_ALIASES[key]] = value
+        elif key in cfg_fields or key in _EXTRA_ALLOWED:
+            out[key] = value
+        elif key == "machine_list_filename":
+            out["machine_list_file"] = value
+        else:
+            Log.fatal("Unknown parameter: %s", key)
+    for key, value in aliased.items():
+        out.setdefault(key, value)
+    # normalize the reference's *_filename spellings
+    if "data_filename" in out:
+        out["data"] = out.pop("data_filename")
+    if "valid_data_filenames" in out:
+        out["valid_data"] = out.pop("valid_data_filenames")
+    if "is_provide_training_metric" in out:
+        out["is_training_metric"] = out.pop("is_provide_training_metric")
+    if "poission_max_delta_step" in out:
+        out["poisson_max_delta_step"] = out.pop("poission_max_delta_step")
+    return out
+
+
+def _parse_bool(key: str, value: Any) -> bool:
+    if isinstance(value, bool):
+        return value
+    v = str(value).lower()
+    if v in ("true", "+", "1"):
+        return True
+    if v in ("false", "-", "0"):
+        return False
+    Log.fatal('Parameter %s should be "true"/"+" or "false"/"-", got "%s"', key, value)
+    raise AssertionError  # unreachable
+
+
+def _parse_list(value: Any, typ) -> list:
+    if isinstance(value, (list, tuple)):
+        return [typ(v) for v in value]
+    s = str(value).strip()
+    if not s:
+        return []
+    return [typ(v) for v in s.replace(",", " ").split()]
+
+
+def params_to_str(params: Dict[str, Any]) -> str:
+    """Serialize a param dict to 'k=v k=v' (basic.py param_dict_to_str)."""
+    pairs = []
+    for key, value in params.items():
+        if isinstance(value, (list, tuple)):
+            value = ",".join(str(v) for v in value)
+        pairs.append(f"{key}={value}")
+    return " ".join(pairs)

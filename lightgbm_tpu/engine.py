@@ -1,0 +1,7 @@
+"""Placeholder — implemented in a later milestone."""
+def train(*a, **k):
+    raise NotImplementedError
+
+
+def cv(*a, **k):
+    raise NotImplementedError

@@ -1,0 +1,346 @@
+"""Binned dataset — counterpart of the reference's Dataset/Metadata
+(src/io/dataset.cpp, src/io/metadata.cpp, include/LightGBM/dataset.h).
+
+TPU-first design: instead of per-feature-group Bin objects (dense /
+sparse / 4-bit / ordered variants, feature_group.h), the whole dataset is
+ONE dense row-major ``(N, F)`` uint8/uint16 matrix of bin indices that is
+transferred to HBM once and stays resident.  Histogram construction over it
+is a single XLA/Pallas kernel (ops/histogram.py) rather than per-group
+virtual dispatch.  Sparse/EFB storage optimizations are deliberately
+deferred: on TPU, dense with ``sparse_threshold=1.0`` is the recommended
+configuration in the reference's own GPU docs (docs/GPU-Performance.md:112).
+
+Parity notes:
+- trivial-feature filtering and used-feature mapping ↔ Dataset::Construct
+  (dataset.cpp:210)
+- metadata (labels/weights/query boundaries/init score) ↔ Metadata
+  (dataset.h:36–248, metadata.cpp)
+- binary cache save/load ↔ SaveBinaryFile/LoadFromBinFile
+  (dataset.cpp, dataset_loader.cpp:263) — here an .npz with a magic key.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..config import Config
+from ..utils.log import Log
+from ..utils.random import Random
+from .binning import CATEGORICAL, NUMERICAL, BinMapper
+
+_BINARY_MAGIC = "lightgbm_tpu.dataset.v1"
+
+
+class Metadata:
+    """Labels, weights, query boundaries, init scores (dataset.h:36–248)."""
+
+    def __init__(self, num_data: int = 0):
+        self.num_data = num_data
+        self.label: np.ndarray = np.zeros(num_data, dtype=np.float32)
+        self.weights: Optional[np.ndarray] = None
+        self.query_boundaries: Optional[np.ndarray] = None
+        self.query_weights: Optional[np.ndarray] = None
+        self.init_score: Optional[np.ndarray] = None
+
+    def set_label(self, label: Sequence[float]) -> None:
+        label = np.asarray(label, dtype=np.float32).ravel()
+        if len(label) != self.num_data:
+            Log.fatal("Length of label (%d) != num_data (%d)", len(label), self.num_data)
+        self.label = label
+
+    def set_weights(self, weights: Optional[Sequence[float]]) -> None:
+        if weights is None:
+            self.weights = None
+            return
+        weights = np.asarray(weights, dtype=np.float32).ravel()
+        if len(weights) != self.num_data:
+            Log.fatal("Length of weights (%d) != num_data (%d)", len(weights), self.num_data)
+        self.weights = weights
+
+    def set_query(self, group: Optional[Sequence[int]]) -> None:
+        """``group`` is per-query sizes (python API convention); builds
+        cumulative query boundaries like Metadata::SetQuery."""
+        if group is None:
+            self.query_boundaries = None
+            return
+        group = np.asarray(group, dtype=np.int64).ravel()
+        if int(group.sum()) != self.num_data:
+            Log.fatal("Sum of query counts (%d) != num_data (%d)", int(group.sum()), self.num_data)
+        self.query_boundaries = np.concatenate([[0], np.cumsum(group)]).astype(np.int64)
+
+    def set_init_score(self, init_score: Optional[Sequence[float]]) -> None:
+        if init_score is None:
+            self.init_score = None
+            return
+        self.init_score = np.asarray(init_score, dtype=np.float64).ravel()
+
+    @property
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
+
+
+class BinnedDataset:
+    """The device-ready binned training data.
+
+    Attributes
+    ----------
+    binned : (num_data, num_used_features) np.uint8 or np.uint16
+        Bin index of each (row, used-feature).
+    bin_mappers : list[BinMapper], one per used feature.
+    used_feature_map : original feature index of each used feature.
+    num_total_features : raw feature count before trivial filtering.
+    """
+
+    def __init__(self):
+        self.binned: np.ndarray = np.zeros((0, 0), dtype=np.uint8)
+        self.bin_mappers: List[BinMapper] = []
+        self.used_feature_map: np.ndarray = np.array([], dtype=np.int32)
+        self.num_total_features: int = 0
+        self.metadata = Metadata(0)
+        self.feature_names: List[str] = []
+        self.max_bin: int = 255
+        self.label_idx: int = 0
+        # raw (unbinned) copy is not kept — predictions on training data run
+        # on the binned representation like the reference's score updater.
+
+    # ------------------------------------------------------------------
+    @property
+    def num_data(self) -> int:
+        return self.binned.shape[0]
+
+    @property
+    def num_features(self) -> int:
+        """Number of used (non-trivial) features."""
+        return self.binned.shape[1]
+
+    def num_bin(self, fidx: int) -> int:
+        return self.bin_mappers[fidx].num_bin
+
+    @property
+    def max_num_bin(self) -> int:
+        return max((m.num_bin for m in self.bin_mappers), default=1)
+
+    def real_threshold(self, fidx: int, bin_idx: int) -> float:
+        return self.bin_mappers[fidx].bin_to_value(int(bin_idx))
+
+    def inner_to_real_feature(self, fidx: int) -> int:
+        return int(self.used_feature_map[fidx])
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_raw(
+        cls,
+        data: np.ndarray,
+        config: Config,
+        *,
+        label: Optional[Sequence[float]] = None,
+        weight: Optional[Sequence[float]] = None,
+        group: Optional[Sequence[int]] = None,
+        init_score: Optional[Sequence[float]] = None,
+        feature_names: Optional[List[str]] = None,
+        categorical_features: Optional[Sequence[int]] = None,
+        reference: Optional["BinnedDataset"] = None,
+        sample_indices: Optional[np.ndarray] = None,
+    ) -> "BinnedDataset":
+        """Construct from a raw dense float matrix.
+
+        Mirrors DatasetLoader::ConstructBinMappersFromTextData +
+        ExtractFeaturesFromMemory (dataset_loader.cpp:661, :840): sample rows,
+        find bins per feature, then push every row through the mappers.
+        With ``reference`` given, reuses its bin mappers (CreateValid /
+        LoadFromFileAlignWithOtherDataset path).
+        """
+        data = np.asarray(data, dtype=np.float64)
+        if data.ndim != 2:
+            Log.fatal("data must be 2-dimensional")
+        n, num_features = data.shape
+        ds = cls()
+        ds.num_total_features = num_features
+        ds.max_bin = config.max_bin
+        ds.metadata = Metadata(n)
+        if label is not None:
+            ds.metadata.set_label(label)
+        ds.metadata.set_weights(weight)
+        ds.metadata.set_query(group)
+        ds.metadata.set_init_score(init_score)
+        ds.feature_names = list(feature_names) if feature_names else [
+            f"Column_{i}" for i in range(num_features)
+        ]
+
+        if reference is not None:
+            ds.bin_mappers = reference.bin_mappers
+            ds.used_feature_map = reference.used_feature_map
+            ds.num_total_features = reference.num_total_features
+            ds.feature_names = reference.feature_names
+            ds.max_bin = reference.max_bin
+        else:
+            cat_set = set(int(c) for c in categorical_features) if categorical_features else set()
+            mappers = _find_bin_mappers(data, config, cat_set, sample_indices)
+            used = [i for i, m in enumerate(mappers) if not m.is_trivial]
+            if not used:
+                Log.fatal("Cannot construct Dataset: all features are trivial (constant)")
+            ds.bin_mappers = [mappers[i] for i in used]
+            ds.used_feature_map = np.asarray(used, dtype=np.int32)
+
+        ds.binned = _bin_matrix(data, ds.bin_mappers, ds.used_feature_map)
+        return ds
+
+    def create_valid(self, data, **kwargs) -> "BinnedDataset":
+        """Validation dataset aligned with this dataset's bin mappers
+        (Dataset::CreateValid, dataset.cpp)."""
+        from ..config import Config as _C
+
+        return BinnedDataset.from_raw(data, _C(), reference=self, **kwargs)
+
+    def subset(self, indices: np.ndarray) -> "BinnedDataset":
+        """Row subset sharing bin mappers (Dataset::CopySubset)."""
+        indices = np.asarray(indices)
+        ds = BinnedDataset()
+        ds.binned = self.binned[indices]
+        ds.bin_mappers = self.bin_mappers
+        ds.used_feature_map = self.used_feature_map
+        ds.num_total_features = self.num_total_features
+        ds.feature_names = self.feature_names
+        ds.max_bin = self.max_bin
+        ds.metadata = Metadata(len(indices))
+        ds.metadata.set_label(self.metadata.label[indices])
+        if self.metadata.weights is not None:
+            ds.metadata.set_weights(self.metadata.weights[indices])
+        if self.metadata.init_score is not None:
+            ns = len(self.metadata.init_score) // max(self.metadata.num_data, 1)
+            sc = self.metadata.init_score.reshape(ns, -1)[:, indices] if ns > 1 else None
+            if ns > 1:
+                ds.metadata.set_init_score(sc.ravel())
+            else:
+                ds.metadata.set_init_score(self.metadata.init_score[indices])
+        return ds
+
+    # ------------------------------------------------------------------
+    def feature_infos(self) -> List[str]:
+        """feature_infos= strings for the model file, indexed by ORIGINAL
+        feature id (trivial features report 'none')."""
+        infos = ["none"] * self.num_total_features
+        for inner, real in enumerate(self.used_feature_map):
+            infos[int(real)] = self.bin_mappers[inner].to_string()
+        return infos
+
+    # ------------------------------------------------------------------
+    def save_binary(self, path: str) -> None:
+        """Binary dataset cache (↔ Dataset::SaveBinaryFile)."""
+        payload: Dict[str, np.ndarray] = {
+            "magic": np.asarray(_BINARY_MAGIC),
+            "binned": self.binned,
+            "used_feature_map": self.used_feature_map,
+            "num_total_features": np.asarray(self.num_total_features),
+            "feature_names": np.asarray(self.feature_names),
+            "max_bin": np.asarray(self.max_bin),
+            "label": self.metadata.label,
+            "num_mappers": np.asarray(len(self.bin_mappers)),
+        }
+        if self.metadata.weights is not None:
+            payload["weights"] = self.metadata.weights
+        if self.metadata.query_boundaries is not None:
+            payload["query_boundaries"] = self.metadata.query_boundaries
+        if self.metadata.init_score is not None:
+            payload["init_score"] = self.metadata.init_score
+        for i, m in enumerate(self.bin_mappers):
+            st = m.state()
+            payload[f"m{i}_meta"] = np.asarray(
+                [
+                    st["num_bin"],
+                    st["bin_type"],
+                    int(st["is_trivial"]),
+                    st["default_bin"],
+                ],
+                dtype=np.int64,
+            )
+            payload[f"m{i}_fl"] = np.asarray(
+                [st["sparse_rate"], st["min_val"], st["max_val"]], dtype=np.float64
+            )
+            payload[f"m{i}_bounds"] = st["bin_upper_bound"]
+            payload[f"m{i}_cats"] = st["bin_2_categorical"]
+        np.savez_compressed(path, **payload)
+
+    @classmethod
+    def load_binary(cls, path: str) -> "BinnedDataset":
+        with np.load(path, allow_pickle=False) as z:
+            if str(z["magic"]) != _BINARY_MAGIC:
+                Log.fatal("File %s is not a lightgbm_tpu binary dataset", path)
+            ds = cls()
+            ds.binned = z["binned"]
+            ds.used_feature_map = z["used_feature_map"]
+            ds.num_total_features = int(z["num_total_features"])
+            ds.feature_names = [str(s) for s in z["feature_names"]]
+            ds.max_bin = int(z["max_bin"])
+            ds.metadata = Metadata(ds.binned.shape[0])
+            ds.metadata.set_label(z["label"])
+            if "weights" in z:
+                ds.metadata.set_weights(z["weights"])
+            if "query_boundaries" in z:
+                ds.metadata.query_boundaries = z["query_boundaries"].astype(np.int64)
+            if "init_score" in z:
+                ds.metadata.set_init_score(z["init_score"])
+            for i in range(int(z["num_mappers"])):
+                meta = z[f"m{i}_meta"]
+                fl = z[f"m{i}_fl"]
+                ds.bin_mappers.append(
+                    BinMapper.from_state(
+                        {
+                            "num_bin": meta[0],
+                            "bin_type": meta[1],
+                            "is_trivial": bool(meta[2]),
+                            "default_bin": meta[3],
+                            "sparse_rate": fl[0],
+                            "min_val": fl[1],
+                            "max_val": fl[2],
+                            "bin_upper_bound": z[f"m{i}_bounds"],
+                            "bin_2_categorical": z[f"m{i}_cats"],
+                        }
+                    )
+                )
+        return ds
+
+
+# ----------------------------------------------------------------------
+def _find_bin_mappers(
+    data: np.ndarray,
+    config: Config,
+    categorical: set,
+    sample_indices: Optional[np.ndarray],
+) -> List[BinMapper]:
+    """Sample rows then FindBin per feature (dataset_loader.cpp:661–776)."""
+    n = data.shape[0]
+    if sample_indices is None:
+        rng = Random(config.data_random_seed)
+        sample_cnt = min(config.bin_construct_sample_cnt, n)
+        sample_indices = rng.sample(n, sample_cnt)
+    sampled = data[sample_indices]
+    total = sampled.shape[0]
+    mappers: List[BinMapper] = []
+    for f in range(data.shape[1]):
+        col = sampled[:, f]
+        col = col[~np.isnan(col)]
+        nonzero = col[col != 0.0]
+        m = BinMapper()
+        m.find_bin(
+            nonzero,
+            total,
+            config.max_bin,
+            config.min_data_in_bin,
+            config.min_data_in_leaf,
+            CATEGORICAL if f in categorical else NUMERICAL,
+        )
+        mappers.append(m)
+    return mappers
+
+
+def _bin_matrix(data: np.ndarray, mappers: List[BinMapper], used_map: np.ndarray) -> np.ndarray:
+    max_bins = max((m.num_bin for m in mappers), default=2)
+    dtype = np.uint8 if max_bins <= 256 else np.uint16
+    out = np.empty((data.shape[0], len(mappers)), dtype=dtype)
+    for inner, real in enumerate(used_map):
+        out[:, inner] = mappers[inner].value_to_bin(data[:, int(real)]).astype(dtype)
+    return out

@@ -1,0 +1,187 @@
+"""Text data loading — counterpart of the reference's Parser
+(src/io/parser.cpp) and the text-file half of DatasetLoader
+(src/io/dataset_loader.cpp).
+
+Format auto-detection mirrors Parser::CreateParser: sniff the first
+non-empty lines; ':'-separated index:value tokens ⇒ LibSVM, otherwise the
+delimiter (tab/comma/space) picks TSV/CSV.  Side files ``<data>.weight``
+and ``<data>.query`` are picked up like Metadata::Init (metadata.cpp).
+
+Dense parsing is delegated to pandas.read_csv (C engine) — the runtime
+replacement for the reference's multithreaded TextReader pipeline — with a
+numpy fallback.  A native C++ chunked parser can be slotted in behind
+``load_text_file`` later without touching callers.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..utils.log import Log
+
+
+def sniff_format(path: str, max_lines: int = 32) -> Tuple[str, Optional[str]]:
+    """Returns (kind, sep) where kind in {'libsvm','csv','tsv'}."""
+    lines: List[str] = []
+    with open(path, "r") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                lines.append(line)
+            if len(lines) >= max_lines:
+                break
+    if not lines:
+        Log.fatal("Data file %s is empty", path)
+    colon_hits = 0
+    for ln in lines:
+        toks = ln.replace("\t", " ").split()
+        # LibSVM: all tokens after the first look like idx:value
+        if len(toks) > 1 and all(":" in t for t in toks[1:]):
+            colon_hits += 1
+    if colon_hits == len(lines):
+        return "libsvm", None
+    first = lines[0]
+    if "\t" in first:
+        return "tsv", "\t"
+    if "," in first:
+        return "csv", ","
+    return "tsv", r"\s+"
+
+
+def load_text_file(
+    path: str, config: Config
+) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray], Optional[np.ndarray], List[str], int]:
+    """Load a training/validation text file.
+
+    Returns (features, label, weights, group_sizes, feature_names, label_idx).
+    ``features`` excludes the label/weight/group/ignored columns, matching how
+    the reference's parsers emit (feature_idx, value) pairs with the label
+    split out.
+    """
+    kind, sep = sniff_format(path)
+    if kind == "libsvm":
+        raw, label = _load_libsvm(path)
+        names = [f"Column_{i}" for i in range(raw.shape[1])]
+        label_idx = 0
+        weights, group = _side_files(path, raw.shape[0])
+        return raw, label, weights, group, names, label_idx
+
+    import pandas as pd
+
+    header = 0 if config.has_header else None
+    df = pd.read_csv(path, sep=sep, header=header, engine="c" if sep != r"\s+" else "python")
+    names = [str(c) for c in df.columns] if config.has_header else None
+
+    label_idx = _resolve_column(config.label_column, names, default=0)
+    weight_idx = _resolve_column(config.weight_column, names, default=-1)
+    group_idx = _resolve_column(config.group_column, names, default=-1)
+    ignore = _resolve_columns(config.ignore_column, names)
+
+    mat = df.to_numpy(dtype=np.float64)
+    label = mat[:, label_idx].astype(np.float32)
+
+    # Column indices for weight/group/ignore in the reference do NOT count
+    # the label column (config.h:119-133); translate to absolute indices.
+    def absolute(idx: int) -> int:
+        if idx < 0 or (config.weight_column and config.weight_column.startswith("name:")):
+            return idx
+        return idx if idx < label_idx else idx + 1
+
+    drop = {label_idx}
+    weights = None
+    if weight_idx >= 0:
+        ai = absolute(weight_idx)
+        weights = mat[:, ai].astype(np.float32)
+        drop.add(ai)
+    group = None
+    if group_idx >= 0:
+        ai = absolute(group_idx)
+        gid = mat[:, ai]
+        # group column holds query ids; convert runs to sizes
+        change = np.nonzero(np.diff(gid))[0] + 1
+        bounds = np.concatenate([[0], change, [len(gid)]])
+        group = np.diff(bounds).astype(np.int64)
+        drop.add(ai)
+    for ig in ignore:
+        drop.add(absolute(ig))
+
+    keep = [i for i in range(mat.shape[1]) if i not in drop]
+    features = mat[:, keep]
+    feat_names = (
+        [names[i] for i in keep] if names else [f"Column_{i}" for i in range(len(keep))]
+    )
+
+    fweights, fgroup = _side_files(path, features.shape[0])
+    if weights is None:
+        weights = fweights
+    if group is None:
+        group = fgroup
+    return features, label, weights, group, feat_names, label_idx
+
+
+def _resolve_column(spec: str, names: Optional[List[str]], default: int) -> int:
+    if not spec:
+        return default
+    if spec.startswith("name:"):
+        name = spec[5:]
+        if not names:
+            Log.fatal("Column name '%s' given but the file has no header", name)
+        if name not in names:
+            Log.fatal("Column '%s' not found in header", name)
+        return names.index(name)
+    return int(spec)
+
+
+def _resolve_columns(spec: str, names: Optional[List[str]]) -> List[int]:
+    if not spec:
+        return []
+    if spec.startswith("name:"):
+        assert names is not None
+        return [names.index(s) for s in spec[5:].split(",")]
+    return [int(s) for s in spec.split(",")]
+
+
+def _side_files(path: str, num_data: int):
+    """<data>.weight and <data>.query companions (metadata.cpp LoadWeights/
+    LoadQueryBoundaries)."""
+    weights = None
+    group = None
+    wpath = path + ".weight"
+    if os.path.exists(wpath):
+        weights = np.loadtxt(wpath, dtype=np.float32).ravel()
+        if len(weights) != num_data:
+            Log.fatal("Weight file length mismatch: %d vs %d", len(weights), num_data)
+    qpath = path + ".query"
+    if os.path.exists(qpath):
+        group = np.loadtxt(qpath, dtype=np.int64).ravel()
+        if int(group.sum()) != num_data:
+            Log.fatal("Query file row total mismatch")
+    return weights, group
+
+
+def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    labels: List[float] = []
+    rows: List[List[Tuple[int, float]]] = []
+    max_idx = -1
+    with open(path, "r") as f:
+        for line in f:
+            toks = line.split()
+            if not toks:
+                continue
+            labels.append(float(toks[0]))
+            row: List[Tuple[int, float]] = []
+            for t in toks[1:]:
+                i, v = t.split(":")
+                idx = int(i)
+                row.append((idx, float(v)))
+                max_idx = max(max_idx, idx)
+            rows.append(row)
+    mat = np.zeros((len(rows), max_idx + 1), dtype=np.float64)
+    for r, row in enumerate(rows):
+        for idx, v in row:
+            mat[r, idx] = v
+    return mat, np.asarray(labels, dtype=np.float32)

@@ -1,0 +1,15 @@
+"""Placeholder — implemented in a later milestone."""
+class LGBMModel:
+    pass
+
+
+class LGBMRegressor:
+    pass
+
+
+class LGBMClassifier:
+    pass
+
+
+class LGBMRanker:
+    pass

@@ -1,0 +1,2 @@
+from .log import Log
+from .random import Random

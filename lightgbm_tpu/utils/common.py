@@ -1,0 +1,44 @@
+"""Small shared helpers (counterpart of include/LightGBM/utils/common.h).
+
+Most of the reference's Common:: helpers (string split/atof, ParallelSort,
+Softmax) are subsumed by numpy/jax; what remains here are the pieces other
+modules genuinely share.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def array_to_string(arr, sep: str = " ") -> str:
+    """Format a 1-D array the way the reference's Common::ArrayToString does
+    (repr chosen per dtype; used by the model text format)."""
+    out = []
+    for v in arr:
+        if isinstance(v, (int, np.integer)):
+            out.append(str(int(v)))
+        else:
+            out.append(format_double(float(v)))
+    return sep.join(out)
+
+
+def format_double(v: float) -> str:
+    """Shortest round-trip decimal for a double, matching how the model text
+    format prints real numbers (C++ operator<< with default precision for
+    display fields; full precision via repr for values that must round-trip)."""
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def softmax(x: np.ndarray, axis: int = -1) -> np.ndarray:
+    x = x - np.max(x, axis=axis, keepdims=True)
+    e = np.exp(x)
+    return e / np.sum(e, axis=axis, keepdims=True)
+
+
+def check(condition: bool, msg: str = "check failed") -> None:
+    if not condition:
+        from .log import Log
+
+        Log.fatal(msg)

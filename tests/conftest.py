@@ -1,0 +1,26 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh so
+multi-chip sharding paths (data/feature/voting-parallel learners) are
+exercised without TPU pod hardware. Must run before jax is imported."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def examples_dir():
+    for cand in ("/root/repo/examples", "/root/reference/examples"):
+        if os.path.isdir(cand):
+            return cand
+    pytest.skip("no examples directory")
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

@@ -1,0 +1,138 @@
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.binning import CATEGORICAL, NUMERICAL, BinMapper, greedy_find_bin
+from lightgbm_tpu.io.dataset import BinnedDataset
+
+
+def test_greedy_find_bin_few_distinct():
+    vals = np.array([1.0, 2.0, 3.0])
+    counts = np.array([10, 10, 10])
+    bounds = greedy_find_bin(vals, counts, max_bin=10, total_cnt=30, min_data_in_bin=1)
+    assert bounds[-1] == np.inf
+    assert bounds[:-1] == [1.5, 2.5]
+
+
+def test_greedy_find_bin_min_data_in_bin():
+    vals = np.array([1.0, 2.0, 3.0, 4.0])
+    counts = np.array([1, 1, 1, 100])
+    bounds = greedy_find_bin(vals, counts, max_bin=10, total_cnt=103, min_data_in_bin=3)
+    # first bin must absorb 1.0,2.0,3.0 before closing
+    assert bounds[0] == 3.5
+    assert bounds[-1] == np.inf
+
+
+def test_greedy_find_bin_many_distinct_equal_count():
+    vals = np.arange(1000, dtype=np.float64) + 1.0
+    counts = np.ones(1000, dtype=np.int64)
+    bounds = greedy_find_bin(vals, counts, max_bin=10, total_cnt=1000, min_data_in_bin=0)
+    assert len(bounds) == 10
+    # roughly equal-count bins
+    binned = np.searchsorted(np.asarray(bounds), vals, side="left")
+    _, cnt = np.unique(binned, return_counts=True)
+    assert cnt.min() >= 50
+
+
+def test_bin_mapper_zero_bin_and_default():
+    # positive values plus implicit zeros: bin 0 must be the zero bin
+    rng = np.random.RandomState(0)
+    nonzero = rng.uniform(1.0, 10.0, size=500)
+    m = BinMapper()
+    m.find_bin(nonzero, total_sample_cnt=1000, max_bin=16, min_data_in_bin=1, min_split_data=1)
+    assert not m.is_trivial
+    assert m.default_bin == 0
+    assert m.value_to_bin(0.0) == 0
+    assert m.value_to_bin(100.0) == m.num_bin - 1
+    # ordering preserved
+    b = m.value_to_bin(np.array([1.0, 5.0, 9.0]))
+    assert b[0] <= b[1] <= b[2]
+
+
+def test_bin_mapper_negative_values_interior_zero():
+    rng = np.random.RandomState(1)
+    nonzero = np.concatenate([rng.uniform(-5, -1, 300), rng.uniform(1, 5, 300)])
+    m = BinMapper()
+    m.find_bin(nonzero, total_sample_cnt=700, max_bin=32, min_data_in_bin=1, min_split_data=1)
+    d = m.default_bin
+    assert 0 < d < m.num_bin - 1
+    assert m.value_to_bin(0.0) == d
+    assert m.value_to_bin(-10.0) == 0
+    assert m.value_to_bin(10.0) == m.num_bin - 1
+
+
+def test_bin_mapper_trivial():
+    m = BinMapper()
+    m.find_bin(np.array([]), total_sample_cnt=100, max_bin=16, min_data_in_bin=1, min_split_data=1)
+    assert m.is_trivial
+
+
+def test_bin_mapper_categorical():
+    vals = np.array([1.0] * 50 + [2.0] * 30 + [3.0] * 15 + [4.0] * 5)
+    m = BinMapper()
+    m.find_bin(vals, total_sample_cnt=100, max_bin=3, min_data_in_bin=1,
+               min_split_data=1, bin_type=CATEGORICAL)
+    assert m.bin_type == CATEGORICAL
+    assert m.value_to_bin(1.0) == 0  # most frequent first
+    assert m.value_to_bin(999.0) == m.num_bin - 1  # unseen -> last bin
+
+
+def test_value_to_bin_monotone_roundtrip():
+    rng = np.random.RandomState(3)
+    nonzero = rng.normal(size=2000)
+    m = BinMapper()
+    m.find_bin(nonzero, total_sample_cnt=2500, max_bin=64, min_data_in_bin=3, min_split_data=3)
+    xs = np.sort(rng.normal(size=100))
+    bins = m.value_to_bin(xs)
+    assert np.all(np.diff(bins) >= 0)
+    # values map inside their bin's bounds
+    for x, b in zip(xs, bins):
+        assert x <= m.bin_upper_bound[b] + 1e-12
+
+
+def test_binned_dataset_from_raw_and_binary_roundtrip(tmp_path):
+    rng = np.random.RandomState(7)
+    X = rng.normal(size=(500, 6))
+    X[:, 3] = 1.0  # trivial feature
+    y = rng.normal(size=500)
+    cfg = Config.from_params({"max_bin": 16, "min_data_in_bin": 1})
+    ds = BinnedDataset.from_raw(X, cfg, label=y)
+    assert ds.num_data == 500
+    assert ds.num_features == 5  # trivial feature filtered
+    assert ds.num_total_features == 6
+    assert ds.binned.dtype == np.uint8
+
+    p = str(tmp_path / "cache.npz")
+    ds.save_binary(p)
+    ds2 = BinnedDataset.load_binary(p)
+    assert np.array_equal(ds.binned, ds2.binned)
+    assert np.allclose(ds.metadata.label, ds2.metadata.label)
+    assert len(ds2.bin_mappers) == len(ds.bin_mappers)
+    assert np.allclose(ds.bin_mappers[0].bin_upper_bound, ds2.bin_mappers[0].bin_upper_bound)
+
+
+def test_valid_aligned_with_train():
+    rng = np.random.RandomState(11)
+    X = rng.normal(size=(400, 4))
+    Xv = rng.normal(size=(100, 4))
+    cfg = Config.from_params({"max_bin": 32})
+    ds = BinnedDataset.from_raw(X, cfg, label=rng.normal(size=400))
+    dv = ds.create_valid(Xv, label=rng.normal(size=100))
+    assert dv.num_features == ds.num_features
+    assert dv.bin_mappers is ds.bin_mappers
+
+
+def test_config_aliases_and_unknown():
+    cfg = Config.from_params({"num_leaf": 63, "sub_feature": 0.8, "reg_alpha": 0.5})
+    assert cfg.num_leaves == 63
+    assert cfg.feature_fraction == 0.8
+    assert cfg.lambda_l1 == 0.5
+    from lightgbm_tpu.utils.log import LightGBMError
+
+    with pytest.raises(LightGBMError):
+        Config.from_params({"definitely_not_a_param": 1})
+
+
+def test_config_canonical_priority():
+    cfg = Config.from_params({"num_iterations": 7, "num_boost_round": 9})
+    assert cfg.num_iterations == 7
