@@ -1,0 +1,19 @@
+"""Device compute ops: histogram construction, split finding, tree growth,
+prediction.  This package is the TPU counterpart of the reference's
+src/treelearner/ + the hot half of src/io/ (dense_bin.hpp histogram kernel,
+feature_histogram.hpp split scan) rebuilt as jitted XLA/Pallas programs.
+"""
+
+from .histogram import build_histogram
+from .split import best_split_all_features
+from .grow import GrowParams, grow_tree
+from .predict import predict_binned, predict_raw
+
+__all__ = [
+    "build_histogram",
+    "best_split_all_features",
+    "GrowParams",
+    "grow_tree",
+    "predict_binned",
+    "predict_raw",
+]

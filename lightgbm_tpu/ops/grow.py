@@ -1,0 +1,274 @@
+"""Leaf-wise tree growth under jit — counterpart of
+SerialTreeLearner::Train (src/treelearner/serial_tree_learner.cpp:152-207)
+plus DataPartition (data_partition.hpp) and the histogram pool.
+
+TPU-first redesign:
+- The per-leaf index lists of DataPartition become one flat ``leaf_id[N]``
+  vector updated by a predicate on the split feature's bin column
+  (partition-by-predicate: O(N) per split, no index shuffling, static
+  shapes).
+- The LRU HistogramPool becomes a dense ``(num_leaves, F, B, 3)`` pool —
+  every active leaf keeps its histogram so the subtraction trick
+  (larger child = parent - smaller) is one tensor subtract
+  (serial_tree_learner.cpp:484-489).
+- The best-first loop is a ``lax.while_loop`` whose state carries the
+  per-leaf best-split table (best_split_per_leaf_); each iteration splits
+  the argmax-gain leaf and recomputes best splits only for the two
+  children, exactly like the reference.
+- The reference's BeforeFindBestSplit data-count gate (both children
+  < 2*min_data_in_leaf) is subsumed by the in-scan min_data masks — a leaf
+  with cnt < 2*min_data can never satisfy min_data on both sides — so only
+  the max_depth gate is applied explicitly.
+
+Everything is static-shaped: one XLA compile per
+(N, F, B, num_leaves) configuration, reused across all boosting
+iterations.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .histogram import ROW_BLOCK, build_histogram
+from .split import (
+    NEG_INF,
+    FeatureMeta,
+    SplitHyper,
+    best_split_all_features,
+    leaf_output,
+)
+
+
+class GrowParams(NamedTuple):
+    """Static (compile-time) growth parameters."""
+
+    num_leaves: int
+    num_bins: int  # padded B
+    max_depth: int = -1
+    use_missing: bool = True
+    row_block: int = ROW_BLOCK
+
+
+class GrowResult(NamedTuple):
+    """Arrays describing the grown tree; host code turns this into a Tree
+    model (model/tree.py).  Record index s = s-th split."""
+
+    num_splits: jnp.ndarray  # scalar int32; num_leaves = num_splits + 1
+    leaf_id: jnp.ndarray  # (N,) int32 final leaf of every row
+    leaf_value: jnp.ndarray  # (L,) raw (pre-shrinkage) outputs
+    leaf_cnt: jnp.ndarray  # (L,) f32
+    rec_leaf: jnp.ndarray  # (L-1,) int32 leaf index that was split
+    rec_feat: jnp.ndarray  # (L-1,) int32 inner feature
+    rec_thr: jnp.ndarray  # (L-1,) int32 threshold bin
+    rec_dbz: jnp.ndarray  # (L-1,) int32 default_bin_for_zero
+    rec_gain: jnp.ndarray  # (L-1,) f32 split gain
+    rec_lval: jnp.ndarray  # (L-1,) f32 left child output
+    rec_rval: jnp.ndarray  # (L-1,) f32 right child output
+    rec_lcnt: jnp.ndarray  # (L-1,) f32
+    rec_rcnt: jnp.ndarray  # (L-1,) f32
+    rec_internal_value: jnp.ndarray  # (L-1,) f32 parent leaf value
+
+
+class _State(NamedTuple):
+    num_splits: jnp.ndarray
+    done: jnp.ndarray
+    leaf_id: jnp.ndarray
+    pool: jnp.ndarray  # (L, F, B, 3)
+    # best_split_per_leaf_ table
+    bs_gain: jnp.ndarray  # (L,)
+    bs_feat: jnp.ndarray
+    bs_thr: jnp.ndarray
+    bs_dbz: jnp.ndarray
+    bs_left: jnp.ndarray  # (L, 3) left (sum_g, sum_h, cnt)
+    # per-leaf totals & bookkeeping
+    leaf_sum: jnp.ndarray  # (L, 3)
+    leaf_value: jnp.ndarray  # (L,)
+    leaf_cnt: jnp.ndarray  # (L,)
+    leaf_depth: jnp.ndarray  # (L,)
+    # split records
+    rec_leaf: jnp.ndarray
+    rec_feat: jnp.ndarray
+    rec_thr: jnp.ndarray
+    rec_dbz: jnp.ndarray
+    rec_gain: jnp.ndarray
+    rec_lval: jnp.ndarray
+    rec_rval: jnp.ndarray
+    rec_lcnt: jnp.ndarray
+    rec_rcnt: jnp.ndarray
+    rec_internal_value: jnp.ndarray
+
+
+def _store_split(st: _State, leaf, res) -> _State:
+    """Write a SplitResult into the per-leaf best-split table."""
+    return st._replace(
+        bs_gain=st.bs_gain.at[leaf].set(res.gain),
+        bs_feat=st.bs_feat.at[leaf].set(res.feature),
+        bs_thr=st.bs_thr.at[leaf].set(res.threshold_bin),
+        bs_dbz=st.bs_dbz.at[leaf].set(res.default_bin_for_zero),
+        bs_left=st.bs_left.at[leaf].set(
+            jnp.stack([res.left_sum_g, res.left_sum_h, res.left_cnt])
+        ),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def grow_tree(
+    bins: jnp.ndarray,
+    grad: jnp.ndarray,
+    hess: jnp.ndarray,
+    select: jnp.ndarray,
+    feature_mask: jnp.ndarray,
+    meta: FeatureMeta,
+    hyper: SplitHyper,
+    params: GrowParams,
+) -> GrowResult:
+    """Grow one leaf-wise tree.  See module docstring."""
+    n, f = bins.shape
+    L = params.num_leaves
+    B = params.num_bins
+
+    def hist_of(sel):
+        return build_histogram(bins, grad, hess, sel, B, params.row_block)
+
+    def find_best(hist, sums, depth_ok):
+        res = best_split_all_features(
+            hist, sums[0], sums[1], sums[2], meta, hyper, feature_mask,
+            use_missing=params.use_missing,
+        )
+        return res._replace(gain=jnp.where(depth_ok, res.gain, NEG_INF))
+
+    # ---- root (BeforeTrain: LeafSplits::Init + root histogram)
+    tg = jnp.sum(grad * select)
+    th = jnp.sum(hess * select)
+    tc = jnp.sum(select)
+    root_hist = hist_of(select)
+    root_sums = jnp.stack([tg, th, tc])
+    root_depth_ok = (params.max_depth <= 0) or True  # root depth 0 < any max_depth >= 1
+    root_res = best_split_all_features(
+        root_hist, tg, th, tc, meta, hyper, feature_mask, use_missing=params.use_missing
+    )
+
+    zi = jnp.zeros((L,), jnp.int32)
+    zf = jnp.zeros((L,))
+    zr = jnp.zeros((L - 1,))
+    zri = jnp.zeros((L - 1,), jnp.int32)
+    st = _State(
+        num_splits=jnp.int32(0),
+        done=jnp.array(False),
+        leaf_id=jnp.zeros((n,), jnp.int32),
+        pool=jnp.zeros((L, f, B, 3)).at[0].set(root_hist),
+        bs_gain=jnp.full((L,), NEG_INF),
+        bs_feat=zi,
+        bs_thr=zi,
+        bs_dbz=zi,
+        bs_left=jnp.zeros((L, 3)),
+        leaf_sum=jnp.zeros((L, 3)).at[0].set(root_sums),
+        leaf_value=zf,
+        leaf_cnt=zf.at[0].set(tc),
+        leaf_depth=zi,
+        rec_leaf=zri, rec_feat=zri, rec_thr=zri, rec_dbz=zri,
+        rec_gain=zr, rec_lval=zr, rec_rval=zr, rec_lcnt=zr, rec_rcnt=zr,
+        rec_internal_value=zr,
+    )
+    st = _store_split(st, 0, root_res)
+    del root_depth_ok
+
+    def cond(st: _State):
+        return (~st.done) & (st.num_splits < L - 1)
+
+    def body(st: _State):
+        best_leaf = jnp.argmax(st.bs_gain).astype(jnp.int32)
+        gain = st.bs_gain[best_leaf]
+        # "No further splits with positive gain" (serial_tree_learner.cpp:191)
+        return jax.lax.cond(gain > 0.0, _split, lambda s: s._replace(done=True), st)
+
+    def _split(st: _State):
+        s = st.num_splits
+        bl = jnp.argmax(st.bs_gain).astype(jnp.int32)
+        right_leaf = (s + 1).astype(jnp.int32)
+
+        feat = st.bs_feat[bl]
+        thr = st.bs_thr[bl]
+        dbz = st.bs_dbz[bl]
+        gain = st.bs_gain[bl]
+        left = st.bs_left[bl]  # (3,)
+        totals = st.leaf_sum[bl]
+        right = totals - left
+        lg, lh, lc = left[0], left[1], left[2]
+        rg, rh, rc = right[0], right[1], right[2]
+        lval = leaf_output(lg, lh, hyper.lambda_l1, hyper.lambda_l2)
+        rval = leaf_output(rg, rh, hyper.lambda_l1, hyper.lambda_l2)
+
+        # ---- partition by predicate (DataPartition::Split + the
+        # DefaultValueForZero bin remap, dense_bin.hpp:191-232)
+        col = jnp.take(bins, feat, axis=1).astype(jnp.int32)
+        zero_bin = meta.default_bin[feat]
+        fval = jnp.where(col == zero_bin, dbz, col)
+        is_cat = meta.is_categorical[feat]
+        goes_left = jnp.where(is_cat, fval == thr, fval <= thr)
+        in_leaf = st.leaf_id == bl
+        leaf_id = jnp.where(in_leaf & ~goes_left, right_leaf, st.leaf_id)
+
+        # ---- histograms: smaller child direct, larger by subtraction
+        is_left_smaller = lc < rc
+        smaller_id = jnp.where(is_left_smaller, bl, right_leaf)
+        smaller_hist = hist_of(select * (leaf_id == smaller_id))
+        larger_hist = st.pool[bl] - smaller_hist
+        left_hist = jnp.where(is_left_smaller, smaller_hist, larger_hist)
+        right_hist = jnp.where(is_left_smaller, larger_hist, smaller_hist)
+        pool = st.pool.at[bl].set(left_hist).at[right_leaf].set(right_hist)
+
+        # ---- children best splits (max_depth gate from BeforeFindBestSplit)
+        child_depth = st.leaf_depth[bl] + 1
+        depth_ok = (
+            jnp.array(True)
+            if params.max_depth <= 0
+            else child_depth < params.max_depth
+        )
+        lres = find_best(left_hist, left, depth_ok)
+        rres = find_best(right_hist, right, depth_ok)
+
+        st = st._replace(
+            num_splits=s + 1,
+            leaf_id=leaf_id,
+            pool=pool,
+            leaf_sum=st.leaf_sum.at[bl].set(left).at[right_leaf].set(right),
+            leaf_value=st.leaf_value.at[bl].set(lval).at[right_leaf].set(rval),
+            leaf_cnt=st.leaf_cnt.at[bl].set(lc).at[right_leaf].set(rc),
+            leaf_depth=st.leaf_depth.at[bl].set(child_depth).at[right_leaf].set(child_depth),
+            rec_leaf=st.rec_leaf.at[s].set(bl),
+            rec_feat=st.rec_feat.at[s].set(feat),
+            rec_thr=st.rec_thr.at[s].set(thr),
+            rec_dbz=st.rec_dbz.at[s].set(dbz),
+            rec_gain=st.rec_gain.at[s].set(gain),
+            rec_lval=st.rec_lval.at[s].set(lval),
+            rec_rval=st.rec_rval.at[s].set(rval),
+            rec_lcnt=st.rec_lcnt.at[s].set(lc),
+            rec_rcnt=st.rec_rcnt.at[s].set(rc),
+            rec_internal_value=st.rec_internal_value.at[s].set(st.leaf_value[bl]),
+        )
+        st = _store_split(st, bl, lres)
+        st = _store_split(st, right_leaf, rres)
+        return st
+
+    st = jax.lax.while_loop(cond, body, st)
+    return GrowResult(
+        num_splits=st.num_splits,
+        leaf_id=st.leaf_id,
+        leaf_value=st.leaf_value,
+        leaf_cnt=st.leaf_cnt,
+        rec_leaf=st.rec_leaf,
+        rec_feat=st.rec_feat,
+        rec_thr=st.rec_thr,
+        rec_dbz=st.rec_dbz,
+        rec_gain=st.rec_gain,
+        rec_lval=st.rec_lval,
+        rec_rval=st.rec_rval,
+        rec_lcnt=st.rec_lcnt,
+        rec_rcnt=st.rec_rcnt,
+        rec_internal_value=st.rec_internal_value,
+    )

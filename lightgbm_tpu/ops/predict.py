@@ -1,0 +1,147 @@
+"""Batched tree traversal — counterpart of Tree::Predict / GetLeaf
+(include/LightGBM/tree.h:232-276) and Tree::AddPredictionToScore
+(src/io/tree.cpp:107-260).
+
+The reference walks one record at a time through pointer-chasing nodes;
+here the whole batch walks in lockstep: a (N,) node-index vector advances
+one level per ``while_loop`` step via gathers into the SoA node arrays.
+Trees are stacked on a leading axis and vmapped, so a full model predicts
+in one compiled program.
+
+Two variants:
+- ``predict_binned`` traverses with bin-space thresholds over the binned
+  (N, F) matrix — used for train/valid score updates, where the data is
+  already binned with the model's own mappers (exactly the semantics of
+  the reference's score updater which predicts on the training Dataset).
+- ``predict_raw`` traverses with real-valued thresholds over raw features,
+  with the zero/missing remap DefaultValueForZero (tree.h:147-161).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..io.binning import MISSING_VALUE_RANGE
+
+
+class TreeArrays:
+    """Stacked SoA node arrays for T trees, padded to M = max nodes.
+
+    Built host-side by model/gbdt_model.py. A tree with num_leaves == 1
+    must have node 0 as (left=~0, right=~0) and leaf_value[0] = its
+    constant output (0 for an empty tree).
+    """
+
+    FIELDS = (
+        "split_feature",  # (T, M) int32 — inner (binned) feature for binned path
+        "split_feature_real",  # (T, M) int32 — original feature for raw path
+        "threshold_bin",  # (T, M) int32
+        "threshold_real",  # (T, M) f32
+        "zero_bin",  # (T, M) int32
+        "default_bin_for_zero",  # (T, M) int32
+        "default_value_real",  # (T, M) f32
+        "is_categorical",  # (T, M) bool
+        "left_child",  # (T, M) int32  (>=0 node, <0 → leaf ~idx)
+        "right_child",  # (T, M) int32
+        "leaf_value",  # (T, L) f32 (post-shrinkage)
+    )
+
+    def __init__(self, **kw):
+        for f in self.FIELDS:
+            setattr(self, f, kw[f])
+
+    def tree_tuple(self):
+        return tuple(getattr(self, f) for f in self.FIELDS)
+
+
+def _traverse_one_tree_binned(bins, feat, thr_bin, zero_bin, dbz, is_cat, left, right):
+    """(N,) leaf indices for one tree over binned data."""
+    n = bins.shape[0]
+    rows = jnp.arange(n)
+
+    def cond(node):
+        return jnp.any(node >= 0)
+
+    def step(node):
+        j = jnp.maximum(node, 0)
+        col = bins[rows, feat[j]].astype(jnp.int32)
+        fval = jnp.where(col == zero_bin[j], dbz[j], col)
+        goes_left = jnp.where(is_cat[j], fval == thr_bin[j], fval <= thr_bin[j])
+        nxt = jnp.where(goes_left, left[j], right[j])
+        return jnp.where(node >= 0, nxt, node)
+
+    node = jnp.zeros((n,), jnp.int32)
+    node = jax.lax.while_loop(cond, step, node)
+    return ~node  # leaf index
+
+
+def _traverse_one_tree_raw(data, feat, thr, default_value, is_cat, left, right):
+    n = data.shape[0]
+    rows = jnp.arange(n)
+
+    def cond(node):
+        return jnp.any(node >= 0)
+
+    def step(node):
+        j = jnp.maximum(node, 0)
+        v = data[rows, feat[j]]
+        # DefaultValueForZero: |v| in (-range, range] → default_value
+        is_zero = (v > -MISSING_VALUE_RANGE) & (v <= MISSING_VALUE_RANGE)
+        is_zero = is_zero | jnp.isnan(v)  # NaN rides the zero bin (ValueToBin)
+        fval = jnp.where(is_zero, default_value[j], v)
+        goes_left = jnp.where(is_cat[j], fval.astype(jnp.int32) == thr[j].astype(jnp.int32), fval <= thr[j])
+        nxt = jnp.where(goes_left, left[j], right[j])
+        return jnp.where(node >= 0, nxt, node)
+
+    node = jnp.zeros((n,), jnp.int32)
+    node = jax.lax.while_loop(cond, step, node)
+    return ~node
+
+
+@jax.jit
+def predict_binned(bins, split_feature, threshold_bin, zero_bin, default_bin_for_zero,
+                   is_categorical, left_child, right_child, leaf_value):
+    """Sum of leaf outputs over stacked trees, binned traversal.
+
+    All tree arrays are (T, M)/(T, L); returns (N,) f32 scores.
+    """
+    leaves = jax.vmap(
+        _traverse_one_tree_binned, in_axes=(None, 0, 0, 0, 0, 0, 0, 0)
+    )(bins, split_feature, threshold_bin, zero_bin, default_bin_for_zero,
+      is_categorical, left_child, right_child)  # (T, N)
+    vals = jnp.take_along_axis(leaf_value, leaves, axis=1)  # (T, N)
+    return jnp.sum(vals, axis=0)
+
+
+@jax.jit
+def predict_leaf_binned(bins, split_feature, threshold_bin, zero_bin,
+                        default_bin_for_zero, is_categorical, left_child, right_child):
+    """(T, N) leaf indices (PredictLeafIndex mode)."""
+    return jax.vmap(
+        _traverse_one_tree_binned, in_axes=(None, 0, 0, 0, 0, 0, 0, 0)
+    )(bins, split_feature, threshold_bin, zero_bin, default_bin_for_zero,
+      is_categorical, left_child, right_child)
+
+
+@jax.jit
+def predict_raw(data, split_feature_real, threshold_real, default_value_real,
+                is_categorical, left_child, right_child, leaf_value):
+    """(N,) raw scores over real-valued features."""
+    leaves = jax.vmap(
+        _traverse_one_tree_raw, in_axes=(None, 0, 0, 0, 0, 0, 0)
+    )(data, split_feature_real, threshold_real, default_value_real,
+      is_categorical, left_child, right_child)
+    vals = jnp.take_along_axis(leaf_value, leaves, axis=1)
+    return jnp.sum(vals, axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def add_leaf_outputs(scores, leaf_id, leaf_outputs):
+    """Train-score update: scores += leaf_outputs[leaf_id]
+    (ScoreUpdater::AddScore via the learner's data partition,
+    score_updater.hpp:68-88 — here a single gather since leaf_id[N] is the
+    partition)."""
+    return scores + leaf_outputs[leaf_id]

@@ -1,0 +1,247 @@
+"""Vectorized best-split search over a (F, B, 3) histogram tensor.
+
+Counterpart of FeatureHistogram::FindBestThreshold*
+(src/treelearner/feature_histogram.hpp:71-198, 253-387).  The reference
+scans each feature's bins sequentially in two directions with three
+zero/missing placements; here every (feature, placement, threshold) cell is
+evaluated at once from prefix sums, and the sequential early-`break`s become
+masks (they are monotone in the scan direction, so masking is equivalent).
+
+Zero/missing placements (FindBestThresholdNumerical, hpp:85-96): rows whose
+value is zero/missing live in the feature's `default_bin`; a split may
+route them left (as-if bin 0), naturally (their own bin), or right (as-if
+bin B-1).  The chosen placement is recorded as `default_bin_for_zero` and
+replayed at partition/prediction time (tree.h DefaultValueForZero).
+
+Tie-breaking parity: the reference keeps the first strictly-better
+candidate in scan order, which prefers (a) lower feature index, (b)
+placement order zero-left, natural, zero-right, (c) larger threshold for
+the right-to-left scans (placements zero-left/natural) and smaller
+threshold for the left-to-right scan (zero-right).
+
+Numerical-precision note: the reference accumulates in float64 with
+kEpsilon=1e-15 seeds; this implementation uses float32 (the same trade the
+reference's own GPU path makes with gpu_use_dp=false) and drops the
+epsilons, which are below f32 resolution.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+NEG_INF = jnp.float32(-jnp.inf)
+
+
+class SplitHyper(NamedTuple):
+    """Split-relevant hyperparameters (TreeConfig, config.h:189-234)."""
+
+    lambda_l1: jnp.ndarray
+    lambda_l2: jnp.ndarray
+    min_data_in_leaf: jnp.ndarray
+    min_sum_hessian_in_leaf: jnp.ndarray
+    min_gain_to_split: jnp.ndarray
+
+    @classmethod
+    def from_config(cls, config) -> "SplitHyper":
+        return cls(
+            jnp.float32(config.lambda_l1),
+            jnp.float32(config.lambda_l2),
+            jnp.float32(config.min_data_in_leaf),
+            jnp.float32(config.min_sum_hessian_in_leaf),
+            jnp.float32(config.min_gain_to_split),
+        )
+
+
+class FeatureMeta(NamedTuple):
+    """Static per-feature metadata arrays (FeatureMetainfo, hpp:14-21)."""
+
+    num_bins: jnp.ndarray  # (F,) int32
+    default_bin: jnp.ndarray  # (F,) int32
+    is_categorical: jnp.ndarray  # (F,) bool
+
+    @classmethod
+    def from_dataset(cls, dataset) -> "FeatureMeta":
+        import numpy as np
+        from ..io.binning import CATEGORICAL
+
+        return cls(
+            jnp.asarray(np.array([m.num_bin for m in dataset.bin_mappers], np.int32)),
+            jnp.asarray(np.array([m.default_bin for m in dataset.bin_mappers], np.int32)),
+            jnp.asarray(
+                np.array([m.bin_type == CATEGORICAL for m in dataset.bin_mappers], bool)
+            ),
+        )
+
+
+class SplitResult(NamedTuple):
+    """Scalar best split over all features (SplitInfo, split_info.hpp:17)."""
+
+    gain: jnp.ndarray  # already min_gain_shift-subtracted
+    feature: jnp.ndarray  # inner feature index, int32
+    threshold_bin: jnp.ndarray  # int32
+    default_bin_for_zero: jnp.ndarray  # int32
+    left_sum_g: jnp.ndarray
+    left_sum_h: jnp.ndarray
+    left_cnt: jnp.ndarray
+    right_sum_g: jnp.ndarray
+    right_sum_h: jnp.ndarray
+    right_cnt: jnp.ndarray
+    left_output: jnp.ndarray
+    right_output: jnp.ndarray
+
+
+def leaf_split_gain(sum_g, sum_h, l1, l2):
+    """GetLeafSplitGain (feature_histogram.hpp:230-236)."""
+    reg = jnp.maximum(jnp.abs(sum_g) - l1, 0.0)
+    return reg * reg / (sum_h + l2)
+
+
+def leaf_output(sum_g, sum_h, l1, l2):
+    """CalculateSplittedLeafOutput (feature_histogram.hpp:244-249)."""
+    reg = jnp.maximum(jnp.abs(sum_g) - l1, 0.0)
+    return -jnp.sign(sum_g) * reg / (sum_h + l2)
+
+
+def _argmax_prefer_high(x):
+    """argmax returning the HIGHEST index among ties (right-to-left scan)."""
+    n = x.shape[-1]
+    return n - 1 - jnp.argmax(x[..., ::-1], axis=-1)
+
+
+def best_split_all_features(
+    hist: jnp.ndarray,
+    sum_g: jnp.ndarray,
+    sum_h: jnp.ndarray,
+    num_data: jnp.ndarray,
+    meta: FeatureMeta,
+    hyper: SplitHyper,
+    feature_mask: jnp.ndarray,
+    use_missing: bool = True,
+) -> SplitResult:
+    """Best split across every feature for one leaf.
+
+    hist : (F, B, 3) f32 histogram of (sum_g, sum_h, cnt) per bin.
+    sum_g/sum_h/num_data : leaf totals (LeafSplits snapshot) — used for the
+        complement side exactly like the reference (right = total - left).
+    feature_mask : (F,) f32 0/1 — feature_fraction sampling mask.
+    """
+    f, b, _ = hist.shape
+    l1, l2 = hyper.lambda_l1, hyper.lambda_l2
+    min_cnt = hyper.min_data_in_leaf
+    min_hess = hyper.min_sum_hessian_in_leaf
+
+    gain_shift = leaf_split_gain(sum_g, sum_h, l1, l2)
+    min_gain_shift = gain_shift + hyper.min_gain_to_split
+
+    cum = jnp.cumsum(hist, axis=1)  # (F, B, 3)
+    db = meta.default_bin  # (F,)
+    nb = meta.num_bins  # (F,)
+    hist_db = jnp.take_along_axis(hist, db[:, None, None], axis=1)[:, 0, :]  # (F, 3)
+
+    thr = jnp.arange(b - 1)  # candidate thresholds t: left = bins <= t
+    db_gt_t = (db[:, None] > thr[None, :]).astype(hist.dtype)  # (F, B-1)
+    db_le_t = 1.0 - db_gt_t
+
+    base = cum[:, : b - 1, :]  # natural left sums, (F, B-1, 3)
+    # zero-left: default bin's mass always on the left
+    left_zl = base + db_gt_t[:, :, None] * hist_db[:, None, :]
+    # zero-right: default bin's mass always on the right
+    left_zr = base - db_le_t[:, :, None] * hist_db[:, None, :]
+
+    def eval_placement(left, extra_valid):
+        lg, lh, lc = left[..., 0], left[..., 1], left[..., 2]
+        rg, rh, rc = sum_g - lg, sum_h - lh, num_data - lc
+        valid = (
+            extra_valid
+            & (lc >= min_cnt)
+            & (rc >= min_cnt)
+            & (lh >= min_hess)
+            & (rh >= min_hess)
+            & (thr[None, :] <= nb[:, None] - 2)
+        )
+        gain = leaf_split_gain(lg, lh, l1, l2) + leaf_split_gain(rg, rh, l1, l2)
+        gain = jnp.where(valid & (gain > min_gain_shift), gain, NEG_INF)
+        return gain  # (F, B-1)
+
+    interior = (db > 0) & (db < nb - 1)
+    always = jnp.ones_like(db_gt_t, dtype=bool)
+    if use_missing:
+        # placement order and tie preference mirror
+        # FindBestThresholdNumerical (hpp:85-96)
+        gain_zl = eval_placement(left_zl, always & (thr[None, :] != db[:, None] - 1))
+        gain_nat = eval_placement(base, interior[:, None] & always)
+        gain_zr = eval_placement(
+            left_zr, (nb[:, None] > 2) & (thr[None, :] != db[:, None])
+        )
+        placements = [
+            (gain_zl, left_zl, jnp.zeros_like(db), True),
+            (gain_nat, base, db, True),
+            (gain_zr, left_zr, nb - 1, False),
+        ]
+    else:
+        gain_nat = eval_placement(base, always)
+        placements = [(gain_nat, base, db, True)]
+
+    # per-feature best among numerical placements, honoring scan-order ties
+    best_gain_f = jnp.full((f,), NEG_INF)
+    best_thr_f = jnp.zeros((f,), jnp.int32)
+    best_dbz_f = jnp.zeros((f,), jnp.int32)
+    best_left_f = jnp.zeros((f, 3))
+    for gain_p, left_p, dbz_p, prefer_high in placements:
+        t_idx = _argmax_prefer_high(gain_p) if prefer_high else jnp.argmax(gain_p, axis=1)
+        g_p = jnp.take_along_axis(gain_p, t_idx[:, None], axis=1)[:, 0]
+        l_p = jnp.take_along_axis(left_p, t_idx[:, None, None], axis=1)[:, 0, :]
+        better = g_p > best_gain_f  # strict: earlier placement wins ties
+        best_thr_f = jnp.where(better, t_idx.astype(jnp.int32), best_thr_f)
+        best_dbz_f = jnp.where(better, jnp.broadcast_to(dbz_p, (f,)).astype(jnp.int32), best_dbz_f)
+        best_left_f = jnp.where(better[:, None], l_p, best_left_f)
+        best_gain_f = jnp.where(better, g_p, best_gain_f)
+
+    # categorical one-vs-rest (FindBestThresholdCategorical, hpp:100-198):
+    # left = exactly bin t, decision type "is"; zeros keep their natural bin
+    cg, ch, cc = hist[..., 0], hist[..., 1], hist[..., 2]  # (F, B)
+    og, oh, oc = sum_g - cg, sum_h - ch, num_data - cc
+    cat_valid = (
+        (cc >= min_cnt)
+        & (oc >= min_cnt)
+        & (ch >= min_hess)
+        & (oh >= min_hess)
+        & (jnp.arange(b)[None, :] <= nb[:, None] - 1)
+    )
+    cat_gain = leaf_split_gain(cg, ch, l1, l2) + leaf_split_gain(og, oh, l1, l2)
+    cat_gain = jnp.where(cat_valid & (cat_gain > min_gain_shift), cat_gain, NEG_INF)
+    cat_t = _argmax_prefer_high(cat_gain)  # right-to-left scan
+    cat_best = jnp.take_along_axis(cat_gain, cat_t[:, None], axis=1)[:, 0]
+    cat_left = jnp.take_along_axis(hist, cat_t[:, None, None], axis=1)[:, 0, :]
+
+    is_cat = meta.is_categorical
+    best_gain_f = jnp.where(is_cat, cat_best, best_gain_f)
+    best_thr_f = jnp.where(is_cat, cat_t.astype(jnp.int32), best_thr_f)
+    best_dbz_f = jnp.where(is_cat, db, best_dbz_f)
+    best_left_f = jnp.where(is_cat[:, None], cat_left, best_left_f)
+
+    best_gain_f = jnp.where(feature_mask > 0, best_gain_f, NEG_INF)
+
+    # across features: first max wins (ArrayArgs::ArgMax — lowest index)
+    fbest = jnp.argmax(best_gain_f).astype(jnp.int32)
+    gain = best_gain_f[fbest]
+    left = best_left_f[fbest]
+    lg, lh, lc = left[0], left[1], left[2]
+    rg, rh, rc = sum_g - lg, sum_h - lh, num_data - lc
+    has_split = jnp.isfinite(gain)
+    return SplitResult(
+        gain=jnp.where(has_split, gain - min_gain_shift, NEG_INF),
+        feature=fbest,
+        threshold_bin=best_thr_f[fbest],
+        default_bin_for_zero=best_dbz_f[fbest],
+        left_sum_g=lg,
+        left_sum_h=lh,
+        left_cnt=lc,
+        right_sum_g=rg,
+        right_sum_h=rh,
+        right_cnt=rc,
+        left_output=leaf_output(lg, lh, l1, l2),
+        right_output=leaf_output(rg, rh, l1, l2),
+    )

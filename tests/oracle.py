@@ -1,0 +1,175 @@
+"""Sequential numpy oracle mirroring the reference's exact split-finding and
+tree-growth semantics (float64, scan order, tie-breaking) — the golden
+reference for parity tests, per SURVEY §4's GPU_DEBUG_COMPARE strategy.
+
+Mirrors:
+- FindBestThresholdNumerical/Sequence (feature_histogram.hpp:78-98, 253-387)
+- FindBestThresholdCategorical (feature_histogram.hpp:100-198)
+- SerialTreeLearner::Train best-first loop (serial_tree_learner.cpp:152-207)
+"""
+
+import numpy as np
+
+K_MIN_SCORE = -np.inf
+
+
+def leaf_split_gain(G, H, l1, l2):
+    reg = max(abs(G) - l1, 0.0)
+    return reg * reg / (H + l2)
+
+
+def leaf_output(G, H, l1, l2):
+    reg = max(abs(G) - l1, 0.0)
+    return -np.copysign(reg, G) / (H + l2)
+
+
+class OracleSplit:
+    def __init__(self):
+        self.gain = K_MIN_SCORE
+        self.feature = -1
+        self.threshold = 0
+        self.dbz = 0
+        self.left = (0.0, 0.0, 0)  # sum_g, sum_h, cnt
+
+
+def find_best_threshold_sequence(hist, sum_g, sum_h, num_data, min_gain_shift,
+                                 default_bin, dbz, cfg, best):
+    """hist: (B, 3) ndarray for one feature. Mutates/returns best dict with
+    the reference's strictly-greater update rule."""
+    num_bin = hist.shape[0]
+    dir_ = 1 if dbz == num_bin - 1 else -1
+    skip_default = not (0 < dbz < num_bin - 1)
+    found = False
+    b_gain, b_thr, b_left = K_MIN_SCORE, num_bin, None
+    if dir_ == -1:
+        rg = rh = 0.0
+        rc = 0
+        for t in range(num_bin - 1, 0, -1):
+            if skip_default and t == default_bin:
+                continue
+            rg += hist[t, 0]
+            rh += hist[t, 1]
+            rc += int(hist[t, 2])
+            if rc < cfg["min_data_in_leaf"] or rh < cfg["min_sum_hessian_in_leaf"]:
+                continue
+            lc = num_data - rc
+            if lc < cfg["min_data_in_leaf"]:
+                break
+            lh = sum_h - rh
+            if lh < cfg["min_sum_hessian_in_leaf"]:
+                break
+            lg = sum_g - rg
+            gain = leaf_split_gain(lg, lh, cfg["lambda_l1"], cfg["lambda_l2"]) + \
+                leaf_split_gain(rg, rh, cfg["lambda_l1"], cfg["lambda_l2"])
+            if gain <= min_gain_shift:
+                continue
+            found = True
+            if gain > b_gain:
+                b_gain, b_thr, b_left = gain, t - 1, (lg, lh, lc)
+    else:
+        lg = lh = 0.0
+        lc = 0
+        for t in range(0, num_bin - 1):
+            if skip_default and t == default_bin:
+                continue
+            lg += hist[t, 0]
+            lh += hist[t, 1]
+            lc += int(hist[t, 2])
+            if lc < cfg["min_data_in_leaf"] or lh < cfg["min_sum_hessian_in_leaf"]:
+                continue
+            rc = num_data - lc
+            if rc < cfg["min_data_in_leaf"]:
+                break
+            rh = sum_h - lh
+            if rh < cfg["min_sum_hessian_in_leaf"]:
+                break
+            rg = sum_g - lg
+            gain = leaf_split_gain(lg, lh, cfg["lambda_l1"], cfg["lambda_l2"]) + \
+                leaf_split_gain(rg, rh, cfg["lambda_l1"], cfg["lambda_l2"])
+            if gain <= min_gain_shift:
+                continue
+            found = True
+            if gain > b_gain:
+                b_gain, b_thr, b_left = gain, t, (lg, lh, lc)
+    if found and b_gain > best["gain"]:
+        best.update(gain=b_gain, threshold=b_thr, dbz=dbz, left=b_left)
+
+
+def find_best_threshold_numerical(hist, sum_g, sum_h, num_data, default_bin,
+                                  cfg, use_missing=True):
+    num_bin = hist.shape[0]
+    gain_shift = leaf_split_gain(sum_g, sum_h, cfg["lambda_l1"], cfg["lambda_l2"])
+    min_gain_shift = gain_shift + cfg["min_gain_to_split"]
+    best = dict(gain=K_MIN_SCORE, threshold=num_bin, dbz=default_bin, left=None)
+    if use_missing:
+        find_best_threshold_sequence(hist, sum_g, sum_h, num_data, min_gain_shift,
+                                     default_bin, 0, cfg, best)
+        if 0 < default_bin < num_bin - 1:
+            find_best_threshold_sequence(hist, sum_g, sum_h, num_data, min_gain_shift,
+                                         default_bin, default_bin, cfg, best)
+        if num_bin > 2:
+            find_best_threshold_sequence(hist, sum_g, sum_h, num_data, min_gain_shift,
+                                         default_bin, num_bin - 1, cfg, best)
+    else:
+        find_best_threshold_sequence(hist, sum_g, sum_h, num_data, min_gain_shift,
+                                     default_bin, default_bin, cfg, best)
+    if np.isfinite(best["gain"]):
+        best["gain"] -= min_gain_shift
+    return best
+
+
+def find_best_threshold_categorical(hist, sum_g, sum_h, num_data, default_bin, cfg):
+    num_bin = hist.shape[0]
+    gain_shift = leaf_split_gain(sum_g, sum_h, cfg["lambda_l1"], cfg["lambda_l2"])
+    min_gain_shift = gain_shift + cfg["min_gain_to_split"]
+    best = dict(gain=K_MIN_SCORE, threshold=num_bin, dbz=default_bin, left=None)
+    b_gain, b_thr, b_left = K_MIN_SCORE, num_bin, None
+    found = False
+    for t in range(num_bin - 1, -1, -1):
+        cg, chh, cc = hist[t, 0], hist[t, 1], int(hist[t, 2])
+        if cc < cfg["min_data_in_leaf"] or chh < cfg["min_sum_hessian_in_leaf"]:
+            continue
+        oc = num_data - cc
+        if oc < cfg["min_data_in_leaf"]:
+            continue
+        oh = sum_h - chh
+        if oh < cfg["min_sum_hessian_in_leaf"]:
+            continue
+        og = sum_g - cg
+        gain = leaf_split_gain(og, oh, cfg["lambda_l1"], cfg["lambda_l2"]) + \
+            leaf_split_gain(cg, chh, cfg["lambda_l1"], cfg["lambda_l2"])
+        if gain <= min_gain_shift:
+            continue
+        found = True
+        if gain > b_gain:
+            b_gain, b_thr, b_left = gain, t, (cg, chh, cc)
+    if found:
+        best.update(gain=b_gain - min_gain_shift, threshold=b_thr, left=b_left)
+    return best
+
+
+def build_histogram_np(bins, grad, hess, select, num_bins):
+    """float64 (F, B, 3) histogram oracle."""
+    n, f = bins.shape
+    hist = np.zeros((f, num_bins, 3))
+    for j in range(f):
+        np.add.at(hist[j], bins[:, j], np.stack([grad * select, hess * select, select], 1))
+    return hist
+
+
+def best_split_all_features_np(hist, sum_g, sum_h, num_data, default_bin,
+                               is_cat, num_bins_per_feat, cfg, use_missing=True):
+    """Cross-feature ArgMax (first max wins) over per-feature bests."""
+    best = None
+    for j in range(hist.shape[0]):
+        h = hist[j, : num_bins_per_feat[j]]
+        if is_cat[j]:
+            r = find_best_threshold_categorical(h, sum_g, sum_h, num_data,
+                                                default_bin[j], cfg)
+        else:
+            r = find_best_threshold_numerical(h, sum_g, sum_h, num_data,
+                                              default_bin[j], cfg, use_missing)
+        r["feature"] = j
+        if best is None or r["gain"] > best["gain"]:
+            best = r
+    return best

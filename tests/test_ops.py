@@ -1,0 +1,200 @@
+"""Parity tests: JAX ops vs the sequential float64 numpy oracle
+(tests/oracle.py), per SURVEY §4's golden-comparison strategy."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.histogram import build_histogram
+from lightgbm_tpu.ops.split import FeatureMeta, SplitHyper, best_split_all_features
+from lightgbm_tpu.ops.grow import GrowParams, grow_tree
+
+import oracle
+
+
+def make_data(rng, n=4000, f=8, b=24, missing_frac=0.2):
+    bins = rng.randint(0, b, (n, f)).astype(np.uint8)
+    default_bin = rng.randint(0, b, f).astype(np.int32)
+    # concentrate mass on the default bin to imitate zero-sparsity
+    for j in range(f):
+        m = rng.rand(n) < missing_frac
+        bins[m, j] = default_bin[j]
+    g = rng.randn(n).astype(np.float32)
+    h = np.abs(rng.randn(n)).astype(np.float32) + 0.1
+    return bins, default_bin, g, h
+
+
+CFG = dict(lambda_l1=0.0, lambda_l2=0.0, min_data_in_leaf=20,
+           min_sum_hessian_in_leaf=1e-3, min_gain_to_split=0.0)
+
+
+def jax_meta(default_bin, b, f, is_cat=None):
+    return FeatureMeta(
+        jnp.full((f,), b, jnp.int32),
+        jnp.asarray(default_bin),
+        jnp.asarray(is_cat if is_cat is not None else np.zeros(f, bool)),
+    )
+
+
+def jax_hyper(cfg):
+    return SplitHyper(*(jnp.float32(cfg[k]) for k in (
+        "lambda_l1", "lambda_l2", "min_data_in_leaf",
+        "min_sum_hessian_in_leaf", "min_gain_to_split")))
+
+
+class TestHistogram:
+    def test_matches_oracle(self, rng):
+        bins, _, g, h = make_data(rng)
+        sel = (rng.rand(len(g)) < 0.7).astype(np.float32)
+        hist = np.asarray(build_histogram(jnp.asarray(bins), jnp.asarray(g),
+                                          jnp.asarray(h), jnp.asarray(sel), 24, 512))
+        want = oracle.build_histogram_np(bins, g.astype(np.float64),
+                                         h.astype(np.float64), sel, 24)
+        np.testing.assert_allclose(hist, want, rtol=1e-4, atol=1e-3)
+
+    def test_unpadded_rows(self, rng):
+        # n not a multiple of row_block: padding rows must contribute nothing
+        bins = rng.randint(0, 8, (777, 3)).astype(np.uint8)
+        g = rng.randn(777).astype(np.float32)
+        h = np.ones(777, np.float32)
+        sel = np.ones(777, np.float32)
+        hist = np.asarray(build_histogram(jnp.asarray(bins), jnp.asarray(g),
+                                          jnp.asarray(h), jnp.asarray(sel), 8, 256))
+        assert hist[:, :, 2].sum() == pytest.approx(3 * 777)
+
+
+class TestSplit:
+    @pytest.mark.parametrize("seed", range(5))
+    @pytest.mark.parametrize("cfg_over", [
+        {}, {"lambda_l1": 0.5, "lambda_l2": 1.0},
+        {"min_data_in_leaf": 200}, {"min_gain_to_split": 0.2},
+    ])
+    def test_numerical_vs_oracle(self, seed, cfg_over):
+        rng = np.random.RandomState(seed)
+        cfg = {**CFG, **cfg_over}
+        n, f, b = 4000, 8, 24
+        bins, default_bin, g, h = make_data(rng, n, f, b)
+        hist = oracle.build_histogram_np(bins, g, h, np.ones(n), b)
+        sum_g, sum_h = float(g.sum()), float(h.sum())
+
+        want = oracle.best_split_all_features_np(
+            hist, sum_g, sum_h, n, default_bin, np.zeros(f, bool),
+            np.full(f, b), cfg)
+        got = best_split_all_features(
+            jnp.asarray(hist, jnp.float32), jnp.float32(sum_g), jnp.float32(sum_h),
+            jnp.float32(n), jax_meta(default_bin, b, f), jax_hyper(cfg),
+            jnp.ones((f,)))
+        if not np.isfinite(want["gain"]):
+            assert not np.isfinite(float(got.gain))
+            return
+        # JAX's best must match the oracle's gain; identical (feat, thr, dbz)
+        # unless a float32-level tie
+        assert float(got.gain) == pytest.approx(want["gain"], rel=1e-4, abs=1e-4)
+        if abs(want["gain"]) > 1e-3:
+            assert (int(got.feature), int(got.threshold_bin), int(got.default_bin_for_zero)) == \
+                (want["feature"], want["threshold"], want["dbz"])
+            lg, lh, lc = want["left"]
+            assert float(got.left_cnt) == lc
+            assert float(got.left_sum_g) == pytest.approx(lg, rel=1e-4, abs=1e-3)
+
+    def test_categorical_vs_oracle(self, rng):
+        n, f, b = 4000, 6, 12
+        bins, default_bin, g, h = make_data(rng, n, f, b)
+        is_cat = np.array([True, False, True, False, True, True])
+        hist = oracle.build_histogram_np(bins, g, h, np.ones(n), b)
+        want = oracle.best_split_all_features_np(
+            hist, float(g.sum()), float(h.sum()), n, default_bin, is_cat,
+            np.full(f, b), CFG)
+        got = best_split_all_features(
+            jnp.asarray(hist, jnp.float32), jnp.float32(g.sum()), jnp.float32(h.sum()),
+            jnp.float32(n), jax_meta(default_bin, b, f, is_cat), jax_hyper(CFG),
+            jnp.ones((f,)))
+        assert float(got.gain) == pytest.approx(want["gain"], rel=1e-4, abs=1e-4)
+        assert int(got.feature) == want["feature"]
+        assert int(got.threshold_bin) == want["threshold"]
+
+    def test_feature_mask(self, rng):
+        n, f, b = 2000, 4, 16
+        bins, default_bin, g, h = make_data(rng, n, f, b)
+        hist = oracle.build_histogram_np(bins, g, h, np.ones(n), b).astype(np.float32)
+        full = best_split_all_features(
+            jnp.asarray(hist), jnp.float32(g.sum()), jnp.float32(h.sum()),
+            jnp.float32(n), jax_meta(default_bin, b, f), jax_hyper(CFG), jnp.ones((f,)))
+        mask = np.ones(f, np.float32)
+        mask[int(full.feature)] = 0.0
+        masked = best_split_all_features(
+            jnp.asarray(hist), jnp.float32(g.sum()), jnp.float32(h.sum()),
+            jnp.float32(n), jax_meta(default_bin, b, f), jax_hyper(CFG), jnp.asarray(mask))
+        assert int(masked.feature) != int(full.feature)
+
+
+class TestGrow:
+    def grow(self, rng, num_leaves=16, n=4000, f=8, b=24, cfg=None, **kw):
+        cfg = cfg or CFG
+        bins, default_bin, g, h = make_data(rng, n, f, b)
+        params = GrowParams(num_leaves=num_leaves, num_bins=b, **kw)
+        res = grow_tree(jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h),
+                        jnp.ones((n,)), jnp.ones((f,)),
+                        jax_meta(default_bin, b, f), jax_hyper(cfg), params)
+        return bins, default_bin, g, h, res
+
+    def test_partition_consistency(self, rng):
+        _, _, _, _, res = self.grow(rng)
+        ns = int(res.num_splits)
+        assert 1 <= ns <= 15
+        counts = np.bincount(np.asarray(res.leaf_id), minlength=16)
+        np.testing.assert_array_equal(counts[: ns + 1], np.asarray(res.leaf_cnt)[: ns + 1])
+        assert counts[ns + 1:].sum() == 0
+
+    def test_matches_oracle_tree(self, rng):
+        """Full best-first sequence parity with a sequential oracle grower."""
+        n, f, b, L = 3000, 6, 16, 8
+        bins, default_bin, g, h = make_data(rng, n, f, b)
+        params = GrowParams(num_leaves=L, num_bins=b)
+        res = grow_tree(jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h),
+                        jnp.ones((n,)), jnp.ones((f,)),
+                        jax_meta(default_bin, b, f), jax_hyper(CFG), params)
+
+        # oracle best-first grower
+        leaf_rows = {0: np.arange(n)}
+        best = {}
+
+        def leaf_best(rows):
+            hist = oracle.build_histogram_np(bins[rows], g[rows], h[rows],
+                                             np.ones(len(rows)), b)
+            return oracle.best_split_all_features_np(
+                hist, float(g[rows].sum()), float(h[rows].sum()), len(rows),
+                default_bin, np.zeros(f, bool), np.full(f, b), CFG)
+
+        best[0] = leaf_best(leaf_rows[0])
+        for s in range(int(res.num_splits)):
+            bl = max(best, key=lambda k: best[k]["gain"])
+            assert bl == int(res.rec_leaf[s]), f"split {s} leaf"
+            r = best[bl]
+            assert r["feature"] == int(res.rec_feat[s]), f"split {s} feature"
+            assert r["threshold"] == int(res.rec_thr[s]), f"split {s} threshold"
+            assert r["dbz"] == int(res.rec_dbz[s]), f"split {s} dbz"
+            assert r["gain"] == pytest.approx(float(res.rec_gain[s]), rel=1e-3, abs=1e-3)
+            rows = leaf_rows[bl]
+            col = bins[rows, r["feature"]].astype(np.int64)
+            fv = np.where(col == default_bin[r["feature"]], r["dbz"], col)
+            lmask = fv <= r["threshold"]
+            leaf_rows[bl] = rows[lmask]
+            leaf_rows[s + 1] = rows[~lmask]
+            best[bl] = leaf_best(leaf_rows[bl])
+            best[s + 1] = leaf_best(leaf_rows[s + 1])
+
+    def test_max_depth(self, rng):
+        _, _, _, _, res = self.grow(rng, num_leaves=32, max_depth=2)
+        # depth-2 tree has at most 4 leaves = 3 splits
+        assert int(res.num_splits) <= 3
+
+    def test_leaf_values(self, rng):
+        bins, db, g, h, res = self.grow(rng, cfg={**CFG, "lambda_l2": 1.0})
+        ns = int(res.num_splits)
+        leaf_id = np.asarray(res.leaf_id)
+        for leaf in range(ns + 1):
+            rows = leaf_id == leaf
+            want = oracle.leaf_output(g[rows].sum(), h[rows].sum(), 0.0, 1.0)
+            assert float(res.leaf_value[leaf]) == pytest.approx(want, rel=1e-3, abs=1e-4)
