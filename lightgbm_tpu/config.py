@@ -304,13 +304,13 @@ def canonicalize_params(params: Dict[str, Any]) -> Dict[str, Any]:
             aliased[PARAM_ALIASES[key]] = value
         elif key in cfg_fields or key in _EXTRA_ALLOWED:
             out[key] = value
-        elif key == "machine_list_filename":
-            out["machine_list_file"] = value
         else:
             Log.fatal("Unknown parameter: %s", key)
     for key, value in aliased.items():
         out.setdefault(key, value)
     # normalize the reference's *_filename spellings
+    if "machine_list_filename" in out:
+        out.setdefault("machine_list_file", out.pop("machine_list_filename"))
     if "data_filename" in out:
         out["data"] = out.pop("data_filename")
     if "valid_data_filenames" in out:

@@ -175,6 +175,8 @@ class BinMapper:
         )
         if not self.is_trivial:
             self.default_bin = int(self.value_to_bin(0.0))
+        # sparse_rate computed even for trivial features (bin.cpp:289)
+        if len(cnt_in_bin) > self.default_bin:
             self.sparse_rate = float(cnt_in_bin[self.default_bin]) / max(total_sample_cnt, 1)
 
     def _find_bin_numerical(self, distinct, counts, total_cnt, max_bin, min_data_in_bin):
@@ -185,6 +187,12 @@ class BinMapper:
         left_cnt_data = int(np.sum(counts[left_mask]))
         missing_cnt_data = int(np.sum(counts[zero_mask]))
         right_cnt_data = int(np.sum(counts[right_mask]))
+        # Intentional divergence from bin.cpp:196-204: there, left_cnt stays
+        # 0 when NO value > -kMissingValueRange exists (strictly-negative
+        # feature), so the reference emits a single [inf] bin and drops the
+        # feature as trivial.  Here such features are binned normally —
+        # strictly better behavior, at the cost of bit-parity with reference
+        # models on strictly-negative features (documented per ADVICE r1).
         left_cnt = int(np.sum(left_mask))
 
         bounds: List[float] = []
@@ -239,10 +247,11 @@ class BinMapper:
         self.num_bin = num_bin
         self.bin_2_categorical = uniq[:num_bin].copy()
         self.categorical_2_bin = {int(v): i for i, v in enumerate(self.bin_2_categorical)}
-        cnt_in_bin = cnt[:num_bin].copy()
-        if num_bin > 0:
-            cnt_in_bin[-1] += total_cnt - used_cnt  # unseen values fall in last bin
-        return cnt_in_bin
+        # Parity quirk (bin.cpp:269-271): cnt_in_bin is the FULL distinct
+        # counts — the unseen-value fold `counts_int.back() += ...` lands in
+        # the truncated copy that is immediately discarded — so NeedFilter
+        # and sparse_rate see untruncated per-category counts.
+        return cnt.copy()
 
     # ------------------------------------------------------------------
     def value_to_bin(self, value) -> np.ndarray:

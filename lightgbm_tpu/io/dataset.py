@@ -319,6 +319,10 @@ def _find_bin_mappers(
         sample_indices = rng.sample(n, sample_cnt)
     sampled = data[sample_indices]
     total = sampled.shape[0]
+    # min_data_in_leaf scaled by the sampling fraction, exactly like
+    # dataset_loader.cpp:491-492 / :709-710 — sampled per-bin counts are
+    # proportionally smaller than full-data counts.
+    filter_cnt = int(config.min_data_in_leaf * total / max(n, 1))
     mappers: List[BinMapper] = []
     for f in range(data.shape[1]):
         col = sampled[:, f]
@@ -330,7 +334,7 @@ def _find_bin_mappers(
             total,
             config.max_bin,
             config.min_data_in_bin,
-            config.min_data_in_leaf,
+            filter_cnt,
             CATEGORICAL if f in categorical else NUMERICAL,
         )
         mappers.append(m)

@@ -76,38 +76,40 @@ def load_text_file(
     df = pd.read_csv(path, sep=sep, header=header, engine="c" if sep != r"\s+" else "python")
     names = [str(c) for c in df.columns] if config.has_header else None
 
-    label_idx = _resolve_column(config.label_column, names, default=0)
-    weight_idx = _resolve_column(config.weight_column, names, default=-1)
-    group_idx = _resolve_column(config.group_column, names, default=-1)
+    label_idx, _ = _resolve_column(config.label_column, names, default=0)
+    weight_idx, weight_abs = _resolve_column(config.weight_column, names, default=-1)
+    group_idx, group_abs = _resolve_column(config.group_column, names, default=-1)
     ignore = _resolve_columns(config.ignore_column, names)
 
     mat = df.to_numpy(dtype=np.float64)
     label = mat[:, label_idx].astype(np.float32)
 
-    # Column indices for weight/group/ignore in the reference do NOT count
-    # the label column (config.h:119-133); translate to absolute indices.
-    def absolute(idx: int) -> int:
-        if idx < 0 or (config.weight_column and config.weight_column.startswith("name:")):
+    # Numeric column indices for weight/group/ignore in the reference do NOT
+    # count the label column (config.h:119-133) and need a +1 shift past it;
+    # name:-resolved indices are already header-absolute (per-spec tracking,
+    # ADVICE r1 fix for the global weight_column short-circuit).
+    def absolute(idx: int, is_name: bool) -> int:
+        if idx < 0 or is_name:
             return idx
         return idx if idx < label_idx else idx + 1
 
     drop = {label_idx}
     weights = None
     if weight_idx >= 0:
-        ai = absolute(weight_idx)
+        ai = absolute(weight_idx, weight_abs)
         weights = mat[:, ai].astype(np.float32)
         drop.add(ai)
     group = None
     if group_idx >= 0:
-        ai = absolute(group_idx)
+        ai = absolute(group_idx, group_abs)
         gid = mat[:, ai]
         # group column holds query ids; convert runs to sizes
         change = np.nonzero(np.diff(gid))[0] + 1
         bounds = np.concatenate([[0], change, [len(gid)]])
         group = np.diff(bounds).astype(np.int64)
         drop.add(ai)
-    for ig in ignore:
-        drop.add(absolute(ig))
+    for ig, ig_abs in ignore:
+        drop.add(absolute(ig, ig_abs))
 
     keep = [i for i in range(mat.shape[1]) if i not in drop]
     features = mat[:, keep]
@@ -123,26 +125,28 @@ def load_text_file(
     return features, label, weights, group, feat_names, label_idx
 
 
-def _resolve_column(spec: str, names: Optional[List[str]], default: int) -> int:
+def _resolve_column(spec: str, names: Optional[List[str]], default: int) -> Tuple[int, bool]:
+    """Returns (index, is_header_absolute).  name:-resolved indices are
+    header-absolute; numeric specs are label-relative (config.h:119-133)."""
     if not spec:
-        return default
+        return default, False
     if spec.startswith("name:"):
         name = spec[5:]
         if not names:
             Log.fatal("Column name '%s' given but the file has no header", name)
         if name not in names:
             Log.fatal("Column '%s' not found in header", name)
-        return names.index(name)
-    return int(spec)
+        return names.index(name), True
+    return int(spec), False
 
 
-def _resolve_columns(spec: str, names: Optional[List[str]]) -> List[int]:
+def _resolve_columns(spec: str, names: Optional[List[str]]) -> List[Tuple[int, bool]]:
     if not spec:
         return []
     if spec.startswith("name:"):
         assert names is not None
-        return [names.index(s) for s in spec[5:].split(",")]
-    return [int(s) for s in spec.split(",")]
+        return [(names.index(s), True) for s in spec[5:].split(",")]
+    return [(int(s), False) for s in spec.split(",")]
 
 
 def _side_files(path: str, num_data: int):
