@@ -1,0 +1,112 @@
+"""Benchmark harness — establishes the BASELINE.md north-star metric:
+sec/iteration on Higgs-shaped data (docs/GPU-Performance.md:101-117 config:
+max_bin=63, num_leaves=255, learning_rate=0.1, min_data_in_leaf=1,
+min_sum_hessian_in_leaf=100).
+
+The real Higgs download is unavailable (zero egress), so a synthetic
+Higgs-shaped dataset is generated: N x 28 features with the same binary
+task structure.  Rows default to 1M (vs Higgs 10.5M) to keep the harness
+under a few minutes; the per-iteration time scales linearly in N, so
+`vs_baseline` is computed on the measured config.
+
+Prints ONE JSON line: {"metric": ..., "value": ..., "unit": ...,
+"vs_baseline": ...}.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def make_higgs_shaped(n_rows: int, n_features: int = 28, seed: int = 7):
+    """Synthetic binary data with Higgs-like geometry: a few informative
+    features plus derived/noisy ones, mildly non-linear decision surface."""
+    rng = np.random.RandomState(seed)
+    n_inform = 8
+    w = rng.randn(n_inform)
+    X = rng.randn(n_rows, n_features).astype(np.float32)
+    margin = X[:, :n_inform] @ w + 0.5 * X[:, 0] * X[:, 1] - 0.3 * X[:, 2] ** 2
+    prob = 1.0 / (1.0 + np.exp(-margin / margin.std()))
+    y = (rng.rand(n_rows) < prob).astype(np.float32)
+    return X, y
+
+
+def main():
+    n_rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
+    n_iters = int(os.environ.get("BENCH_ITERS", 20))
+    warmup = 3
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.basic import Booster, Dataset
+
+    X, y = make_higgs_shaped(n_rows)
+    params = {
+        "objective": "binary",
+        "metric": "auc",
+        "max_bin": 63,
+        "num_leaves": 255,
+        "learning_rate": 0.1,
+        "min_data_in_leaf": 1,
+        "min_sum_hessian_in_leaf": 100,
+        "verbose": -1,
+    }
+    t0 = time.time()
+    ds = Dataset(X, label=y, params=dict(params))
+    booster = Booster(params=params, train_set=ds)
+    prep_s = time.time() - t0
+
+    # warmup: trigger all XLA compiles
+    t0 = time.time()
+    for _ in range(warmup):
+        booster.update()
+    import jax
+
+    jax.block_until_ready(booster.boosting.scores)
+    warmup_s = time.time() - t0
+
+    t0 = time.time()
+    for _ in range(n_iters):
+        booster.update()
+    jax.block_until_ready(booster.boosting.scores)
+    train_s = time.time() - t0
+    sec_per_iter = train_s / n_iters
+
+    # quality signal on held-out synthetic rows
+    Xt, yt = make_higgs_shaped(100_000, seed=11)
+    prob = booster.predict(Xt)
+    from lightgbm_tpu.metric.binary import AUCMetric
+    from lightgbm_tpu.config import Config
+
+    m = AUCMetric(Config())
+
+    class _Meta:
+        label = yt
+        weights = None
+
+    m.init(_Meta, len(yt))
+    auc = m.eval(prob)[0][1]
+
+    # vs_baseline: the reference GPU (GTX 1080) trains Higgs-10.5M at about
+    # 0.58 s/iter at this config (docs/GPU-Performance.md external chart,
+    # commonly-cited ~290 s / 500 iters); scale to the measured row count.
+    ref_gpu_sec_per_iter_higgs = 0.58
+    ref_scaled = ref_gpu_sec_per_iter_higgs * (n_rows / 10_500_000)
+    vs_baseline = ref_scaled / sec_per_iter if sec_per_iter > 0 else 0.0
+
+    print(json.dumps({
+        "metric": f"sec/iteration (binary, {n_rows}x28, max_bin=63, num_leaves=255)",
+        "value": round(sec_per_iter, 4),
+        "unit": "s/iter",
+        "vs_baseline": round(vs_baseline, 3),
+        "auc_23iters": round(auc, 5),
+        "prep_s": round(prep_s, 2),
+        "warmup_s": round(warmup_s, 2),
+        "device": str(jax.devices()[0]).split(":")[0],
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

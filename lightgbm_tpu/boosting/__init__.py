@@ -1,0 +1,26 @@
+"""Boosting drivers — counterpart of src/boosting/ (factory
+boosting.cpp:29-73).
+"""
+
+from .gbdt import GBDT
+from .dart import DART
+from .goss import GOSS
+
+
+def create_boosting(boosting_type: str, input_model: str = ""):
+    """Boosting::CreateBoosting (src/boosting/boosting.cpp:29-73)."""
+    from ..utils.log import Log
+
+    bt = boosting_type.lower()
+    if bt == "gbdt":
+        cls = GBDT
+    elif bt == "dart":
+        cls = DART
+    elif bt == "goss":
+        cls = GOSS
+    else:
+        Log.fatal("Unknown boosting type %s", boosting_type)
+    return cls()
+
+
+__all__ = ["GBDT", "DART", "GOSS", "create_boosting"]

@@ -1,0 +1,125 @@
+"""DART (Dropouts meet Multiple Additive Regression Trees) — counterpart of
+src/boosting/dart.hpp (TrainOneIter:49-63, DroppingTrees:84-120,
+Normalize:122-170).
+
+Dropped trees are subtracted from the device score arrays via binned
+traversal (the reference's Shrinkage(-1)+AddScore dance), the new tree
+trains on the dropped scores, then everything is re-normalized.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.random import Random
+from .gbdt import GBDT
+
+
+class DART(GBDT):
+    def init(self, config, train_set, objective, training_metrics=()):
+        super().init(config, train_set, objective, training_metrics)
+        self.random_for_drop = Random(config.drop_seed)
+        self.tree_weight = []
+        self.sum_weight = 0.0
+        self.drop_index = []
+        self.is_update_score_cur_iter = False
+        self.shrinkage_rate = config.learning_rate
+
+    def train_one_iter(self, gradients=None, hessians=None, is_eval=True) -> bool:
+        """dart.hpp:49-63: train (without eval), normalize, then eval."""
+        self.is_update_score_cur_iter = False
+        stopped = super().train_one_iter(gradients, hessians, is_eval=False)
+        if stopped:
+            return True
+        self._normalize()
+        if not self.config.uniform_drop:
+            self.tree_weight.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
+        if is_eval:
+            return self.eval_and_check_early_stopping()
+        return False
+
+    def get_training_score(self):
+        """GetTrainingScore (dart.hpp:66-76): drop trees once per iter
+        before gradients are computed."""
+        if not self.is_update_score_cur_iter:
+            self._dropping_trees()
+            self.is_update_score_cur_iter = True
+        return self.scores
+
+    # ------------------------------------------------------------------
+    def _model_offset(self) -> int:
+        """Trees before iteration 0 (the boost_from_average init tree)."""
+        return 1 if self.boost_from_average_ else 0
+
+    def _dropping_trees(self):
+        """DroppingTrees (dart.hpp:84-120)."""
+        cfg = self.config
+        self.drop_index = []
+        is_skip = self.random_for_drop.next_float() < cfg.skip_drop
+        if not is_skip and self.iter > 0:
+            drop_rate = cfg.drop_rate
+            if not cfg.uniform_drop:
+                inv_avg = len(self.tree_weight) / self.sum_weight if self.sum_weight else 0.0
+                if cfg.max_drop > 0:
+                    drop_rate = min(drop_rate, cfg.max_drop * inv_avg / max(self.sum_weight, 1e-30))
+                for i in range(self.iter):
+                    if self.random_for_drop.next_float() < drop_rate * self.tree_weight[i] * inv_avg:
+                        self.drop_index.append(i)
+            else:
+                if cfg.max_drop > 0:
+                    drop_rate = min(drop_rate, cfg.max_drop / float(self.iter))
+                for i in range(self.iter):
+                    if self.random_for_drop.next_float() < drop_rate:
+                        self.drop_index.append(i)
+        # subtract dropped trees from training scores
+        k = self.num_tree_per_iteration
+        off = self._model_offset()
+        for i in self.drop_index:
+            for tree_id in range(k):
+                tree = self.models[off + i * k + tree_id]
+                tree.shrinkage(-1.0)
+                self._add_tree_to_train_scores(tree, tree_id)
+        ndrop = len(self.drop_index)
+        if not cfg.xgboost_dart_mode:
+            self.shrinkage_rate = cfg.learning_rate / (1.0 + ndrop)
+        else:
+            if ndrop == 0:
+                self.shrinkage_rate = cfg.learning_rate
+            else:
+                self.shrinkage_rate = cfg.learning_rate / (cfg.learning_rate + ndrop)
+
+    def _normalize(self):
+        """Normalize (dart.hpp:122-170)."""
+        cfg = self.config
+        k_drop = float(len(self.drop_index))
+        k = self.num_tree_per_iteration
+        off = self._model_offset()
+        for i in self.drop_index:
+            for tree_id in range(k):
+                tree = self.models[off + i * k + tree_id]
+                if not cfg.xgboost_dart_mode:
+                    tree.shrinkage(1.0 / (k_drop + 1.0))
+                    self._add_tree_to_valid(tree, tree_id)
+                    tree.shrinkage(-k_drop)
+                    self._add_tree_to_train_scores(tree, tree_id)
+                else:
+                    tree.shrinkage(self.shrinkage_rate)
+                    self._add_tree_to_valid(tree, tree_id)
+                    tree.shrinkage(-k_drop / cfg.learning_rate)
+                    self._add_tree_to_train_scores(tree, tree_id)
+            if not cfg.uniform_drop:
+                if not cfg.xgboost_dart_mode:
+                    self.sum_weight -= self.tree_weight[i] * (1.0 / (k_drop + 1.0))
+                    self.tree_weight[i] *= k_drop / (k_drop + 1.0)
+                else:
+                    self.sum_weight -= self.tree_weight[i] * (
+                        1.0 / (k_drop + cfg.learning_rate)
+                    )
+                    self.tree_weight[i] *= k_drop / (k_drop + cfg.learning_rate)
+
+    def _add_tree_to_valid(self, tree, tree_id):
+        self._add_tree_to_valid_scores(tree, tree_id)
+
+    def sub_model_name(self) -> str:
+        return "tree"

@@ -1,0 +1,610 @@
+"""GBDT training driver — counterpart of src/boosting/gbdt.{cpp,h}
+(TrainOneIter gbdt.cpp:381-495, Bagging :252-334, UpdateScore :539-562,
+OutputMetric :564-622, model save/load :854-1008).
+
+TPU-first layout: scores/gradients/hessians are device-resident
+``(num_tree_per_iteration, N)`` f32 arrays; one boosting iteration runs
+  objective.get_gradients  (jnp, fused elementwise)
+  grow_tree                (jitted leaf-wise learner, ops/grow.py)
+  add_leaf_outputs         (gather on the grower's leaf_id partition)
+with only the O(num_leaves) split records returning to host per tree.
+Bagging is a 0/1 row mask multiplied into the histogram kernel's select
+vector — the out-of-bag rows still receive score updates because the
+partition predicate covers every row (the reference needs a separate
+UpdateScoreOutOfBag pass; here it is free).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..model.tree import Tree
+from ..ops.grow import GrowParams, grow_tree
+from ..ops.predict import add_leaf_outputs, predict_binned, predict_raw
+from ..ops.split import FeatureMeta, SplitHyper
+from ..model.ensemble import stack_trees
+from ..utils.log import Log
+from ..utils.random import Random
+
+K_MIN_SCORE = -np.inf
+
+
+class GBDT:
+    """The gradient-boosting driver (class GBDT, gbdt.h:24-258)."""
+
+    def __init__(self):
+        self.models: List[Tree] = []
+        self.iter = 0
+        self.num_init_iteration = 0
+        self.boost_from_average_ = False
+        self.train_set = None
+        self.objective = None
+        self.config = None
+        self.max_feature_idx = 0
+        self.label_idx = 0
+
+    # ------------------------------------------------------------------
+    def init(self, config, train_set, objective, training_metrics=()):
+        """GBDT::Init + ResetTrainingData (gbdt.cpp:65-218)."""
+        self.config = config
+        self.train_set = train_set
+        self.objective = objective
+        self.num_data = train_set.num_data
+        # with a custom objective (objective=None) the class count comes
+        # from config.num_class (gbdt.cpp ResetTrainingData: num_class_)
+        self.num_tree_per_iteration = (
+            objective.num_tree_per_iteration
+            if objective is not None
+            else max(config.num_class, 1)
+        )
+        self.num_class = config.num_class
+        self.max_feature_idx = train_set.num_total_features - 1
+        self.label_idx = getattr(train_set, "label_idx", 0)
+        self.feature_names = train_set.feature_names
+        self.training_metrics = list(training_metrics)
+        self.shrinkage_rate = config.learning_rate
+
+        if objective is not None:
+            objective.init(train_set.metadata, self.num_data)
+
+        # device-resident training state
+        self.bins = jnp.asarray(train_set.binned)
+        self.num_bins = int(train_set.max_num_bin)
+        self.meta = FeatureMeta.from_dataset(train_set)
+        self.hyper = SplitHyper.from_config(config)
+        self.grow_params = GrowParams(
+            num_leaves=config.num_leaves,
+            num_bins=self.num_bins,
+            max_depth=config.max_depth,
+            use_missing=config.use_missing,
+            top_k=config.top_k,
+        )
+        # tree-learner dispatch (TreeLearner::CreateTreeLearner,
+        # tree_learner.cpp:9-33): serial on one chip, or a sharded learner
+        # over the device mesh
+        learner_type = config.tree_learner.lower()
+        self.learner = None
+        if learner_type in ("data", "feature", "voting"):
+            import jax as _jax
+
+            from ..parallel import ShardedLearner, make_mesh
+
+            if len(_jax.devices()) < 2:
+                Log.warning(
+                    "tree_learner=%s requested but only one device is "
+                    "visible; falling back to serial", learner_type,
+                )
+            else:
+                self.learner = ShardedLearner(
+                    learner_type, make_mesh(), self.grow_params
+                )
+        elif learner_type != "serial":
+            Log.fatal("Unknown tree learner type %s", config.tree_learner)
+        k = self.num_tree_per_iteration
+        self.scores = jnp.zeros((k, self.num_data), jnp.float32)
+        init_score = train_set.metadata.init_score
+        self.has_init_score = init_score is not None
+        if self.has_init_score:
+            self.scores = self.scores + jnp.asarray(
+                np.asarray(init_score, np.float32).reshape(k, -1)
+            )
+
+        # validation sets
+        self.valid_sets = []
+        self.valid_bins = []
+        self.valid_scores = []
+        self.valid_metrics = []
+        self.valid_names = []
+        self.best_iter = []
+        self.best_score = []
+        self.best_msg = []
+
+        # bagging state
+        self.bag_rng = np.random.RandomState(config.bagging_seed)
+        self.need_re_bagging = False
+        self.is_bagging = (
+            config.bagging_fraction < 1.0 and config.bagging_freq > 0
+        )
+        self.select = jnp.ones(self.num_data, jnp.float32)
+        self.feature_rng = Random(config.feature_fraction_seed)
+        self.full_feature_mask = jnp.ones(train_set.num_features, jnp.float32)
+
+        # per-class "does this class have data" (SkipEmptyClass handling)
+        self.class_need_train = [True] * k
+        self.class_default_output = [0.0] * k
+
+    def add_valid(self, valid_set, valid_metrics, name: str):
+        """GBDT::AddValidDataset (gbdt.cpp:220-250)."""
+        self.valid_sets.append(valid_set)
+        vb = jnp.asarray(valid_set.binned)
+        self.valid_bins.append(vb)
+        k = self.num_tree_per_iteration
+        vs = jnp.zeros((k, valid_set.num_data), jnp.float32)
+        init_score = valid_set.metadata.init_score
+        if init_score is not None:
+            vs = vs + jnp.asarray(np.asarray(init_score, np.float32).reshape(k, -1))
+        # replay existing models onto the new valid set
+        if self.models:
+            arrays = stack_trees(self.models)
+            for kk in range(k):
+                idx = np.asarray(
+                    [i * k + kk for i in range(len(self.models) // k)]
+                )
+                vs = vs.at[kk].add(
+                    predict_binned(
+                        vb,
+                        arrays["split_feature_inner"][idx],
+                        arrays["threshold_bin"][idx],
+                        arrays["zero_bin"][idx],
+                        arrays["default_bin_for_zero"][idx],
+                        arrays["is_categorical"][idx],
+                        arrays["left_child"][idx],
+                        arrays["right_child"][idx],
+                        arrays["leaf_value"][idx],
+                    )
+                )
+        self.valid_scores.append(vs)
+        self.valid_metrics.append(list(valid_metrics))
+        self.valid_names.append(name)
+        self.best_iter.append([0] * len(valid_metrics))
+        self.best_score.append([K_MIN_SCORE] * len(valid_metrics))
+        self.best_msg.append([""] * len(valid_metrics))
+
+    # ------------------------------------------------------------------
+    def _boost_from_average(self):
+        """gbdt.cpp:381-399 + LabelAverage (:349-379)."""
+        if (
+            not self.models
+            and self.config.boost_from_average
+            and not self.has_init_score
+            and self.num_class <= 1
+            and self.objective is not None
+            and self.objective.boost_from_average
+        ):
+            init_score = float(np.mean(np.asarray(self.train_set.metadata.label)))
+            tree = Tree.constant(init_score)
+            self.scores = self.scores + jnp.float32(init_score)
+            self.valid_scores = [vs + jnp.float32(init_score) for vs in self.valid_scores]
+            self.models.append(tree)
+            self.boost_from_average_ = True
+            Log.info("Start training from score %f", init_score)
+
+    def _bagging(self, iter_: int) -> None:
+        """Re-sample the 0/1 row mask (GBDT::Bagging, gbdt.cpp:275-334)."""
+        if not self.is_bagging or iter_ % self.config.bagging_freq != 0:
+            return
+        bag_cnt = int(self.config.bagging_fraction * self.num_data)
+        perm = self.bag_rng.permutation(self.num_data)
+        mask = np.zeros(self.num_data, np.float32)
+        mask[perm[:bag_cnt]] = 1.0
+        self.select = jnp.asarray(mask)
+
+    def _feature_mask(self):
+        """feature_fraction sampling per tree
+        (SerialTreeLearner::BeforeTrain, serial_tree_learner.cpp:236-262)."""
+        frac = self.config.feature_fraction
+        f = self.train_set.num_features
+        if frac >= 1.0:
+            return self.full_feature_mask
+        used_cnt = max(1, int(f * frac))
+        idx = self.feature_rng.sample(f, used_cnt)
+        mask = np.zeros(f, np.float32)
+        mask[idx] = 1.0
+        return jnp.asarray(mask)
+
+    def _get_gradients(self):
+        """objective_->GetGradients (Boosting(), gbdt.cpp:692-700); returns
+        (K, N) device arrays."""
+        score = self.get_training_score()
+        if self.num_tree_per_iteration == 1:
+            g, h = self.objective.get_gradients(score[0])
+            return g[None, :], h[None, :]
+        return self.objective.get_gradients(score)
+
+    def get_training_score(self):
+        """Hook for DART's drop-then-score (GetTrainingScore)."""
+        return self.scores
+
+    # ------------------------------------------------------------------
+    def train_one_iter(self, gradients=None, hessians=None, is_eval: bool = True) -> bool:
+        """One boosting iteration (GBDT::TrainOneIter, gbdt.cpp:381-495).
+        Returns True when training should stop."""
+        self._boost_from_average()
+
+        if gradients is None or hessians is None:
+            grad, hess = self._get_gradients()
+        else:
+            grad = jnp.asarray(np.asarray(gradients, np.float32).reshape(
+                self.num_tree_per_iteration, -1))
+            hess = jnp.asarray(np.asarray(hessians, np.float32).reshape(
+                self.num_tree_per_iteration, -1))
+
+        grad, hess = self._adjust_gradients(grad, hess)
+        self._bagging(self.iter)
+
+        should_continue = False
+        for k in range(self.num_tree_per_iteration):
+            feature_mask = self._feature_mask()
+            if self.learner is not None:
+                gr = self.learner.grow(
+                    self.bins, grad[k], hess[k], self.select, feature_mask,
+                    self.meta, self.hyper,
+                )
+            else:
+                gr = grow_tree(
+                    self.bins,
+                    grad[k],
+                    hess[k],
+                    self.select,
+                    feature_mask,
+                    self.meta,
+                    self.hyper,
+                    self.grow_params,
+                )
+            num_splits = int(gr.num_splits)
+            if num_splits > 0:
+                should_continue = True
+                tree = Tree.from_grow_result(gr, self.train_set)
+                tree.shrinkage(self.shrinkage_rate)
+                # train-score update via the grower's partition (one gather)
+                lv = np.zeros(self.grow_params.num_leaves, np.float32)
+                lv[: tree.num_leaves] = tree.leaf_value[: tree.num_leaves]
+                leaf_vals = jnp.asarray(lv)
+                self.scores = self.scores.at[k].set(
+                    add_leaf_outputs(self.scores[k], gr.leaf_id, leaf_vals)
+                )
+                self._add_tree_to_valid_scores(tree, k)
+            else:
+                tree = Tree(2)  # empty tree, kept for alignment
+            self.models.append(tree)
+
+        if not should_continue:
+            Log.warning(
+                "Stopped training because there are no more leaves that meet "
+                "the split requirements."
+            )
+            for _ in range(self.num_tree_per_iteration):
+                self.models.pop()
+            return True
+
+        self.iter += 1
+        if is_eval:
+            return self.eval_and_check_early_stopping()
+        return False
+
+    def _adjust_gradients(self, grad, hess):
+        """Hook for GOSS's gradient re-weighting; identity for GBDT."""
+        return grad, hess
+
+    def _add_tree_to_valid_scores(self, tree: Tree, k: int) -> None:
+        arrays = stack_trees([tree])
+        for i, vb in enumerate(self.valid_bins):
+            self.valid_scores[i] = self.valid_scores[i].at[k].add(
+                predict_binned(
+                    vb,
+                    arrays["split_feature_inner"],
+                    arrays["threshold_bin"],
+                    arrays["zero_bin"],
+                    arrays["default_bin_for_zero"],
+                    arrays["is_categorical"],
+                    arrays["left_child"],
+                    arrays["right_child"],
+                    arrays["leaf_value"],
+                )
+            )
+
+    def _add_tree_to_train_scores(self, tree: Tree, k: int) -> None:
+        """Full binned traversal on the training set (used by rollback/DART
+        where the grower's partition is no longer available)."""
+        arrays = stack_trees([tree])
+        self.scores = self.scores.at[k].add(
+            predict_binned(
+                self.bins,
+                arrays["split_feature_inner"],
+                arrays["threshold_bin"],
+                arrays["zero_bin"],
+                arrays["default_bin_for_zero"],
+                arrays["is_categorical"],
+                arrays["left_child"],
+                arrays["right_child"],
+                arrays["leaf_value"],
+            )
+        )
+
+    def rollback_one_iter(self) -> None:
+        """GBDT::RollbackOneIter (gbdt.cpp:497-514)."""
+        if self.iter <= 0:
+            return
+        k = self.num_tree_per_iteration
+        last = self.models[-k:]
+        for tree_id, tree in enumerate(last):
+            tree.shrinkage(-1.0)
+            self._add_tree_to_train_scores(tree, tree_id)
+            for i in range(len(self.valid_bins)):
+                arrays = stack_trees([tree])
+                self.valid_scores[i] = self.valid_scores[i].at[tree_id].add(
+                    predict_binned(
+                        self.valid_bins[i],
+                        arrays["split_feature_inner"],
+                        arrays["threshold_bin"],
+                        arrays["zero_bin"],
+                        arrays["default_bin_for_zero"],
+                        arrays["is_categorical"],
+                        arrays["left_child"],
+                        arrays["right_child"],
+                        arrays["leaf_value"],
+                    )
+                )
+        del self.models[-k:]
+        self.iter -= 1
+
+    # ------------------------------------------------------------------
+    def eval_and_check_early_stopping(self) -> bool:
+        """EvalAndCheckEarlyStopping + OutputMetric (gbdt.cpp:516-622)."""
+        best_msg = self._output_metric(self.iter)
+        if best_msg:
+            Log.info(
+                "Early stopping at iteration %d, the best iteration round is %d",
+                self.iter,
+                self.iter - self.config.early_stopping_round,
+            )
+            Log.info("Output of best iteration round:\n%s", best_msg)
+            n_pop = self.config.early_stopping_round * self.num_tree_per_iteration
+            del self.models[len(self.models) - n_pop:]
+            return True
+        return False
+
+    def _train_score_host(self):
+        return np.asarray(self.scores, np.float64)
+
+    def _valid_score_host(self, i):
+        return np.asarray(self.valid_scores[i], np.float64)
+
+    def _metric_score(self, score):
+        """(K, N) -> what metrics expect: (N,) when single-class."""
+        return score[0] if score.shape[0] == 1 else score
+
+    def _output_metric(self, iter_: int) -> str:
+        es_round = self.config.early_stopping_round
+        need_output = (iter_ % self.config.output_freq) == 0
+        msg_parts = []
+        ret = ""
+        if need_output and self.training_metrics:
+            score = self._metric_score(self._train_score_host())
+            for m in self.training_metrics:
+                for name, val in m.eval(score, self.objective):
+                    line = f"Iteration:{iter_}, training {name} : {val:g}"
+                    Log.info("%s", line)
+                    if es_round > 0:
+                        msg_parts.append(line)
+        meet = []
+        if need_output or es_round > 0:
+            for i in range(len(self.valid_metrics)):
+                score = self._metric_score(self._valid_score_host(i))
+                for j, m in enumerate(self.valid_metrics[i]):
+                    results = m.eval(score, self.objective)
+                    for name, val in results:
+                        line = f"Iteration:{iter_}, valid_{i+1} {name} : {val:g}"
+                        if need_output:
+                            Log.info("%s", line)
+                        if es_round > 0:
+                            msg_parts.append(line)
+                    if not ret and es_round > 0:
+                        factor = 1.0 if m.bigger_is_better else -1.0
+                        cur = factor * results[-1][1]
+                        if cur > self.best_score[i][j]:
+                            self.best_score[i][j] = cur
+                            self.best_iter[i][j] = iter_
+                            meet.append((i, j))
+                        elif iter_ - self.best_iter[i][j] >= es_round:
+                            ret = self.best_msg[i][j]
+        msg = "\n".join(msg_parts)
+        for i, j in meet:
+            self.best_msg[i][j] = msg
+        return ret
+
+    def get_eval_at(self, data_idx: int):
+        """GBDT::GetEvalAt — [(name, value, bigger_is_better), ...] for
+        callbacks/early stopping."""
+        out = []
+        if data_idx == 0:
+            score = self._metric_score(self._train_score_host())
+            metrics = self.training_metrics
+        else:
+            score = self._metric_score(self._valid_score_host(data_idx - 1))
+            metrics = self.valid_metrics[data_idx - 1]
+        for m in metrics:
+            for name, val in m.eval(score, self.objective):
+                out.append((name, val, m.bigger_is_better))
+        return out
+
+    def refresh_config(self) -> None:
+        """Re-derive the config-dependent training state after a parameter
+        reset (ResetConfig path used by callback.reset_parameter)."""
+        self.hyper = SplitHyper.from_config(self.config)
+        self.shrinkage_rate = self.config.learning_rate
+        self.is_bagging = (
+            self.config.bagging_fraction < 1.0 and self.config.bagging_freq > 0
+        )
+        if not self.is_bagging:
+            self.select = jnp.ones(self.num_data, jnp.float32)
+
+    # ------------------------------------------------------------------
+    @property
+    def num_trees(self) -> int:
+        return len(self.models)
+
+    def current_iteration(self) -> int:
+        return self.iter + self.num_init_iteration
+
+    def _used_models(self, num_iteration: int = -1):
+        num_used = len(self.models)
+        if num_iteration > 0:
+            ni = num_iteration + (1 if self.boost_from_average_ else 0)
+            num_used = min(ni * self.num_tree_per_iteration, num_used)
+        return self.models[:num_used]
+
+    def predict_raw_scores(self, data: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+        """(num_pred, N) raw scores over raw (unbinned) features, batched
+        on device (GBDT::PredictRaw)."""
+        models = self._used_models(num_iteration)
+        k = self.num_tree_per_iteration
+        n = data.shape[0]
+        if not models:
+            return np.zeros((k, n))
+        data_dev = jnp.asarray(np.asarray(data, np.float32))
+        arrays = stack_trees(models)
+        out = np.zeros((k, n))
+        for kk in range(k):
+            idx = np.asarray([i for i in range(len(models)) if i % k == kk])
+            out[kk] = np.asarray(
+                predict_raw(
+                    data_dev,
+                    arrays["split_feature"][idx],
+                    arrays["threshold_real"][idx],
+                    arrays["default_value"][idx],
+                    arrays["is_categorical"][idx],
+                    arrays["left_child"][idx],
+                    arrays["right_child"][idx],
+                    arrays["leaf_value"][idx],
+                ),
+                np.float64,
+            )
+        return out
+
+    def predict(self, data: np.ndarray, num_iteration: int = -1,
+                raw_score: bool = False, pred_leaf: bool = False) -> np.ndarray:
+        """Booster-level predict: (N,) or (N, K) converted outputs."""
+        if pred_leaf:
+            models = self._used_models(num_iteration)
+            out = np.stack([t.predict_leaf_index(np.asarray(data, np.float64))
+                            for t in models], axis=1)
+            return out
+        raw = self.predict_raw_scores(data, num_iteration)
+        if raw_score:
+            return raw[0] if raw.shape[0] == 1 else raw.T
+        if self.objective is not None:
+            conv = np.asarray(self.objective.convert_output(jnp.asarray(raw)), np.float64)
+        else:
+            conv = raw
+        return conv[0] if conv.shape[0] == 1 else conv.T
+
+    # ------------------------------------------------------------------
+    def sub_model_name(self) -> str:
+        return "tree"
+
+    def save_model_to_string(self, num_iteration: int = -1) -> str:
+        """GBDT::SaveModelToString (gbdt.cpp:854-898) — reference format."""
+        parts = [self.sub_model_name()]
+        parts.append(f"num_class={self.num_class}")
+        parts.append(f"num_tree_per_iteration={self.num_tree_per_iteration}")
+        parts.append(f"label_index={self.label_idx}")
+        parts.append(f"max_feature_idx={self.max_feature_idx}")
+        if self.objective is not None:
+            parts.append(f"objective={self.objective.to_string()}")
+        if self.boost_from_average_:
+            parts.append("boost_from_average")
+        parts.append("feature_names=" + " ".join(self.feature_names))
+        if self.train_set is not None:
+            parts.append("feature_infos=" + " ".join(self.train_set.feature_infos()))
+        parts.append("")
+        for i, tree in enumerate(self._used_models(num_iteration)):
+            parts.append(f"Tree={i}")
+            parts.append(tree.to_string())
+        parts.append("")
+        parts.append("feature importances:")
+        for name, cnt in self.feature_importance_pairs():
+            parts.append(f"{name}={cnt}")
+        return "\n".join(parts) + "\n"
+
+    def save_model_to_file(self, filename: str, num_iteration: int = -1) -> None:
+        with open(filename, "w") as f:
+            f.write(self.save_model_to_string(num_iteration))
+
+    def load_model_from_string(self, model_str: str) -> None:
+        """GBDT::LoadModelFromString (gbdt.cpp:912-1008)."""
+        self.models = []
+        header, _, rest = model_str.partition("Tree=")
+        kv = {}
+        for line in header.splitlines():
+            if "=" in line:
+                k, _, v = line.partition("=")
+                kv[k.strip()] = v.strip()
+        if "num_class" not in kv:
+            Log.fatal("Model file doesn't specify the number of classes")
+        self.num_class = int(kv["num_class"])
+        self.num_tree_per_iteration = int(
+            kv.get("num_tree_per_iteration", self.num_class)
+        )
+        if "label_index" not in kv:
+            Log.fatal("Model file doesn't specify the label index")
+        self.label_idx = int(kv["label_index"])
+        if "max_feature_idx" not in kv:
+            Log.fatal("Model file doesn't specify max_feature_idx")
+        self.max_feature_idx = int(kv["max_feature_idx"])
+        self.boost_from_average_ = "boost_from_average" in header.splitlines()
+        self.objective_name_loaded = kv.get("objective", "")
+        self.feature_names = kv.get("feature_names", "").split()
+        # tree blocks
+        if rest:
+            blocks = ("Tree=" + rest).split("Tree=")
+            for blk in blocks:
+                blk = blk.strip()
+                if not blk or blk.startswith("feature importances"):
+                    continue
+                body = blk.partition("\n")[2]
+                body = body.split("\nfeature importances:")[0]
+                self.models.append(Tree.from_string(body))
+        self.num_init_iteration = len(self.models) // max(self.num_tree_per_iteration, 1)
+        self.iter = 0
+
+    def feature_importance_pairs(self):
+        """Split-count importance (GBDT::FeatureImportance,
+        gbdt.cpp:1010-1034), sorted descending, nonzero only."""
+        imp = np.zeros(self.max_feature_idx + 1, np.int64)
+        for tree in self.models:
+            m = tree.num_leaves - 1
+            for s in range(m):
+                if tree.split_gain[s] > 0:
+                    imp[tree.split_feature[s]] += 1
+        names = self.feature_names or [
+            f"Column_{i}" for i in range(self.max_feature_idx + 1)
+        ]
+        pairs = [(names[i], int(imp[i])) for i in range(len(imp)) if imp[i] > 0]
+        pairs.sort(key=lambda p: -p[1])
+        return pairs
+
+    def feature_importance(self, importance_type: str = "split") -> np.ndarray:
+        imp = np.zeros(self.max_feature_idx + 1, np.float64)
+        for tree in self.models:
+            m = tree.num_leaves - 1
+            for s in range(m):
+                if tree.split_gain[s] > 0:
+                    if importance_type == "gain":
+                        imp[tree.split_feature[s]] += tree.split_gain[s]
+                    else:
+                        imp[tree.split_feature[s]] += 1
+        return imp

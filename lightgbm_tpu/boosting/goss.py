@@ -1,0 +1,59 @@
+"""GOSS (Gradient-based One-Side Sampling) — counterpart of
+src/boosting/goss.hpp (Bagging:126-198, BaggingHelper:79-124).
+
+TPU-first: the per-thread reservoir loops become one device program —
+|g*h| scoring, ``jax.lax.top_k`` for the keep set, a Bernoulli sample of
+the rest with the (1-a)/b up-weighting folded into the gradient arrays.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.log import Log
+from .gbdt import GBDT
+
+
+class GOSS(GBDT):
+    def init(self, config, train_set, objective, training_metrics=()):
+        super().init(config, train_set, objective, training_metrics)
+        if config.bagging_freq > 0 and config.bagging_fraction != 1.0:
+            Log.fatal("Cannot use bagging in GOSS")
+        Log.info("Using GOSS")
+        if config.top_rate + config.other_rate >= 1.0:
+            # whole data is used; plain gbdt behavior
+            Log.warning("top_rate + other_rate >= 1.0; GOSS degenerates to GBDT")
+        self._goss_key = jax.random.PRNGKey(config.bagging_seed)
+
+    def _adjust_gradients(self, grad, hess):
+        """GOSS sampling (goss.hpp:126-198): no sampling for the first
+        1/learning_rate iterations, then keep top_rate by |g*h|, sample
+        other_rate of the rest up-weighted by (n - top_k)/other_k."""
+        cfg = self.config
+        if self.iter < int(1.0 / cfg.learning_rate):
+            self.select = jnp.ones(self.num_data, jnp.float32)
+            return grad, hess
+        n = self.num_data
+        top_k = max(1, int(n * cfg.top_rate))
+        other_k = max(1, int(n * cfg.other_rate))
+        multiply = (n - top_k) / other_k
+
+        score = jnp.sum(jnp.abs(grad * hess), axis=0)  # (N,)
+        threshold = jax.lax.top_k(score, top_k)[0][-1]
+        is_top = score >= threshold
+        self._goss_key, sub = jax.random.split(self._goss_key)
+        rest_all = n - top_k
+        prob = other_k / max(rest_all, 1)
+        sampled_rest = (~is_top) & (jax.random.uniform(sub, (n,)) < prob)
+        self.select = (is_top | sampled_rest).astype(jnp.float32)
+        scale = jnp.where(sampled_rest, multiply, 1.0).astype(grad.dtype)
+        return grad * scale[None, :], hess * scale[None, :]
+
+    def _bagging(self, iter_):
+        # GOSS replaces bagging entirely (handled in _adjust_gradients)
+        return
+
+    def sub_model_name(self) -> str:
+        return "tree"

@@ -1,7 +1,259 @@
-"""Placeholder — implemented in a later milestone."""
-def train(*a, **k):
-    raise NotImplementedError
+"""Training/cv entry points — counterpart of
+python-package/lightgbm/engine.py (train:17, cv:~250).
+"""
+
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster, Dataset
+from .config import canonicalize_params
+from .utils.log import Log
 
 
-def cv(*a, **k):
-    raise NotImplementedError
+def train(
+    params: Dict[str, Any],
+    train_set: Dataset,
+    num_boost_round: int = 100,
+    valid_sets=None,
+    valid_names=None,
+    fobj=None,
+    feval=None,
+    init_model=None,
+    feature_name="auto",
+    categorical_feature="auto",
+    early_stopping_rounds: Optional[int] = None,
+    evals_result: Optional[dict] = None,
+    verbose_eval=True,
+    learning_rates=None,
+    keep_training_booster: bool = True,
+    callbacks=None,
+) -> Booster:
+    """lgb.train (engine.py:17-199)."""
+    params = dict(params or {})
+    canon = canonicalize_params(params)
+    num_boost_round = int(canon.pop("num_iterations", num_boost_round))
+    if "early_stopping_round" in canon:
+        early_stopping_rounds = int(canon["early_stopping_round"])
+    # strip the loop-controlling keys: the python loop owns iteration count
+    # and early stopping (engine.py:100-118), not the inner driver
+    for alias in ("num_iterations", "num_iteration", "num_tree", "num_trees",
+                  "num_round", "num_rounds", "num_boost_round",
+                  "early_stopping_round", "early_stopping_rounds",
+                  "early_stopping"):
+        params.pop(alias, None)
+
+    if fobj is not None:
+        params.setdefault("objective", "none")
+    if feature_name != "auto":
+        train_set.feature_name = feature_name
+    if categorical_feature != "auto":
+        train_set.categorical_feature = categorical_feature
+
+    booster = Booster(params=params, train_set=train_set)
+    if init_model is not None:
+        _apply_init_model(booster, init_model, train_set)
+
+    # valid sets
+    valid_list: List[Dataset] = []
+    name_list: List[str] = []
+    if valid_sets is not None:
+        if isinstance(valid_sets, Dataset):
+            valid_sets = [valid_sets]
+        for i, vs in enumerate(valid_sets):
+            if vs is train_set:
+                name_list.append("training")
+                valid_list.append(None)  # marker: evaluate on train scores
+                continue
+            if valid_names is not None and i < len(valid_names):
+                name = valid_names[i]
+            else:
+                name = f"valid_{i}"
+            booster.add_valid(vs, name)
+            valid_list.append(vs)
+            name_list.append(name)
+
+    eval_train = "training" in name_list
+
+    # callbacks (engine.py:120-152)
+    cbs = set(callbacks or [])
+    if verbose_eval is True:
+        cbs.add(callback_mod.print_evaluation())
+    elif isinstance(verbose_eval, int) and verbose_eval is not False:
+        cbs.add(callback_mod.print_evaluation(verbose_eval))
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        cbs.add(callback_mod.early_stopping(early_stopping_rounds,
+                                            verbose=bool(verbose_eval)))
+    if learning_rates is not None:
+        cbs.add(callback_mod.reset_parameter(learning_rate=learning_rates))
+    if evals_result is not None:
+        cbs.add(callback_mod.record_evaluation(evals_result))
+    cbs_before = {c for c in cbs if getattr(c, "before_iteration", False)}
+    cbs_after = cbs - cbs_before
+    cbs_before = sorted(cbs_before, key=lambda c: getattr(c, "order", 0))
+    cbs_after = sorted(cbs_after, key=lambda c: getattr(c, "order", 0))
+
+    # training loop
+    for i in range(num_boost_round):
+        for cb in cbs_before:
+            cb(callback_mod.CallbackEnv(booster, params, i, 0, num_boost_round, None))
+        finished = booster.update(fobj=fobj)
+        evaluation_result_list = []
+        if valid_sets is not None or eval_train:
+            if eval_train:
+                evaluation_result_list.extend(booster.eval_train(feval))
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in cbs_after:
+                cb(callback_mod.CallbackEnv(
+                    booster, params, i, 0, num_boost_round, evaluation_result_list))
+        except callback_mod.EarlyStopException as es:
+            booster.best_iteration = es.best_iteration + 1
+            _record_best_score(booster, es.best_score)
+            break
+        if finished:
+            Log.info("Finished training with %d iterations", i + 1)
+            break
+    if booster.best_iteration <= 0:
+        booster.best_iteration = booster.current_iteration()
+    return booster
+
+
+def _record_best_score(booster: Booster, best_score_list) -> None:
+    if not best_score_list:
+        return
+    out: Dict[str, Dict[str, float]] = collections.defaultdict(dict)
+    for item in best_score_list:
+        out[item[0]][item[1]] = item[2]
+    booster.best_score = dict(out)
+
+
+def _apply_init_model(booster: Booster, init_model, train_set: Dataset) -> None:
+    """Continued training (engine.py init_model / gbdt.cpp input_model):
+    load the model and seed the training scores with its predictions."""
+    if isinstance(init_model, Booster):
+        model_str = init_model.model_to_string()
+    else:
+        with open(init_model) as f:
+            model_str = f.read()
+    prev = Booster(params=booster.params, model_str=model_str)
+    b = booster.boosting
+    b.models = prev.boosting.models + b.models
+    b.num_init_iteration = len(prev.boosting.models) // max(
+        prev.boosting.num_tree_per_iteration, 1
+    )
+    b.boost_from_average_ = prev.boosting.boost_from_average_
+    raw = train_set.data
+    if raw is None:
+        Log.fatal("Continued training requires the raw training data")
+    import jax.numpy as jnp
+
+    init_scores = prev.boosting.predict_raw_scores(np.asarray(raw, np.float64))
+    b.scores = b.scores + jnp.asarray(init_scores.astype(np.float32))
+
+
+def cv(
+    params: Dict[str, Any],
+    train_set: Dataset,
+    num_boost_round: int = 10,
+    folds=None,
+    nfold: int = 5,
+    stratified: bool = False,
+    shuffle: bool = True,
+    metrics=None,
+    fobj=None,
+    feval=None,
+    init_model=None,
+    feature_name="auto",
+    categorical_feature="auto",
+    early_stopping_rounds: Optional[int] = None,
+    fpreproc=None,
+    verbose_eval=None,
+    show_stdv: bool = True,
+    seed: int = 0,
+    callbacks=None,
+) -> Dict[str, List[float]]:
+    """lgb.cv (engine.py:~250-400): k-fold cross-validation returning
+    {metric-mean: [...], metric-stdv: [...]}."""
+    params = dict(params or {})
+    if metrics is not None:
+        params["metric"] = metrics
+    canon = canonicalize_params(params)
+    num_boost_round = int(canon.pop("num_iterations", num_boost_round))
+    for alias in ("num_iterations", "num_iteration", "num_tree", "num_trees",
+                  "num_round", "num_rounds", "num_boost_round"):
+        params.pop(alias, None)
+
+    full = train_set.construct()
+    n = full.num_data
+    label = np.asarray(full.metadata.label)
+
+    # build folds (engine.py _make_n_folds)
+    if folds is None:
+        rng = np.random.RandomState(seed)
+        if stratified:
+            try:
+                from sklearn.model_selection import StratifiedKFold
+
+                skf = StratifiedKFold(n_splits=nfold, shuffle=shuffle,
+                                      random_state=seed if shuffle else None)
+                folds = list(skf.split(np.zeros(n), label))
+            except ImportError:
+                stratified = False
+        if not stratified:
+            idx = rng.permutation(n) if shuffle else np.arange(n)
+            parts = np.array_split(idx, nfold)
+            folds = [
+                (np.concatenate([parts[j] for j in range(nfold) if j != i]), parts[i])
+                for i in range(nfold)
+            ]
+
+    boosters = []
+    for train_idx, test_idx in folds:
+        tr = train_set.subset(np.sort(train_idx))
+        te = train_set.subset(np.sort(test_idx))
+        fold_params = params.copy()
+        if fpreproc is not None:
+            # per-fold params stay local (reference engine's tparam)
+            tr, te, fold_params = fpreproc(tr, te, fold_params)
+        bst = Booster(params=fold_params, train_set=tr)
+        bst.add_valid(te, "valid")
+        boosters.append(bst)
+
+    results = collections.defaultdict(list)
+    best_iter = num_boost_round
+    history: List[Dict[str, float]] = []
+    for i in range(num_boost_round):
+        merged = collections.defaultdict(list)
+        for bst in boosters:
+            bst.update(fobj=fobj)
+            for _, name, val, bigger in bst.eval_valid(feval):
+                merged[(name, bigger)].append(val)
+        one = {}
+        for (name, bigger), vals in merged.items():
+            mean, std = float(np.mean(vals)), float(np.std(vals))
+            results[name + "-mean"].append(mean)
+            results[name + "-stdv"].append(std)
+            one[name] = (mean, bigger)
+        history.append(one)
+        if verbose_eval:
+            msg = "\t".join(
+                f"cv_agg {k}: {results[k + '-mean'][-1]:g} + {results[k + '-stdv'][-1]:g}"
+                for k in {name for (name, _) in merged}
+            )
+            Log.info("[%d]\t%s", i + 1, msg)
+        if early_stopping_rounds and len(history) > early_stopping_rounds:
+            # stop when the first metric hasn't improved
+            (name, bigger) = next(iter(merged.keys()))
+            series = results[name + "-mean"]
+            best = int(np.argmax(series) if bigger else np.argmin(series))
+            if len(series) - 1 - best >= early_stopping_rounds:
+                for k in list(results.keys()):
+                    results[k] = results[k][: best + 1]
+                break
+    return dict(results)

@@ -209,6 +209,14 @@ class BinnedDataset:
         ds.metadata.set_label(self.metadata.label[indices])
         if self.metadata.weights is not None:
             ds.metadata.set_weights(self.metadata.weights[indices])
+        if self.metadata.query_boundaries is not None:
+            # map each retained row to its query and count per-query
+            # retained rows, keeping only non-empty queries in order
+            # (Metadata::CheckOrPartition query partitioning)
+            qb = self.metadata.query_boundaries
+            row_query = np.searchsorted(qb, indices, side="right") - 1
+            per_query = np.bincount(row_query, minlength=len(qb) - 1)
+            ds.metadata.set_query(per_query[per_query > 0])
         if self.metadata.init_score is not None:
             ns = len(self.metadata.init_score) // max(self.metadata.num_data, 1)
             sc = self.metadata.init_score.reshape(ns, -1)[:, indices] if ns > 1 else None

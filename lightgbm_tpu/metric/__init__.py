@@ -1,0 +1,85 @@
+"""Metrics — counterpart of src/metric/ (factory metric.cpp:10-41).
+
+Metrics run host-side in float64 numpy: they execute once per
+``metric_freq`` iterations on scores pulled from device, exactly where the
+reference runs its OpenMP loops, and double accumulation preserves parity
+with the reference's `double sum_loss` reductions.
+"""
+
+from .regression import (
+    L1Metric,
+    L2Metric,
+    RMSEMetric,
+    HuberMetric,
+    FairMetric,
+    PoissonMetric,
+)
+from .binary import BinaryLoglossMetric, BinaryErrorMetric, AUCMetric
+from .multiclass import MultiErrorMetric, MultiLoglossMetric
+from .rank import NDCGMetric, MapMetric
+
+_FACTORY = {
+    "l1": L1Metric,
+    "mean_absolute_error": L1Metric,
+    "mae": L1Metric,
+    "regression_l1": L1Metric,
+    "l2": L2Metric,
+    "mean_squared_error": L2Metric,
+    "mse": L2Metric,
+    "regression": L2Metric,
+    "regression_l2": L2Metric,
+    "rmse": RMSEMetric,
+    "root_mean_squared_error": RMSEMetric,
+    "l2_root": RMSEMetric,
+    "huber": HuberMetric,
+    "fair": FairMetric,
+    "poisson": PoissonMetric,
+    "binary_logloss": BinaryLoglossMetric,
+    "binary": BinaryLoglossMetric,
+    "binary_error": BinaryErrorMetric,
+    "auc": AUCMetric,
+    "multi_logloss": MultiLoglossMetric,
+    "multiclass": MultiLoglossMetric,
+    "softmax": MultiLoglossMetric,
+    "multiclassova": MultiLoglossMetric,
+    "multiclass_ova": MultiLoglossMetric,
+    "ova": MultiLoglossMetric,
+    "ovr": MultiLoglossMetric,
+    "multi_error": MultiErrorMetric,
+    "ndcg": NDCGMetric,
+    "lambdarank": NDCGMetric,
+    "map": MapMetric,
+    "mean_average_precision": MapMetric,
+}
+
+
+def create_metric(name: str, config):
+    """Metric::CreateMetric (src/metric/metric.cpp:10-41); returns None for
+    unknown names like the reference (caller warns)."""
+    cls = _FACTORY.get(name.lower())
+    return cls(config) if cls is not None else None
+
+
+def metric_names_for_objective(objective_name: str):
+    """Default metric when none specified — the reference maps the
+    objective name through the same factory (config.cpp metric defaulting)."""
+    return [objective_name]
+
+
+__all__ = [
+    "create_metric",
+    "metric_names_for_objective",
+    "L1Metric",
+    "L2Metric",
+    "RMSEMetric",
+    "HuberMetric",
+    "FairMetric",
+    "PoissonMetric",
+    "BinaryLoglossMetric",
+    "BinaryErrorMetric",
+    "AUCMetric",
+    "MultiLoglossMetric",
+    "MultiErrorMetric",
+    "NDCGMetric",
+    "MapMetric",
+]

@@ -1,0 +1,39 @@
+"""Abstract metric interface (include/LightGBM/metric.h)."""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+
+class Metric:
+    """``eval(score, objective)`` returns [(name, value), ...]; score is a
+    host float64 array — (N,) or (K, N) for multiclass."""
+
+    name = "none"
+    bigger_is_better = False  # factor_to_bigger_better sign
+
+    def init(self, metadata, num_data: int) -> None:
+        self.num_data = num_data
+        self.label = np.asarray(metadata.label, np.float64)
+        self.weights = (
+            np.asarray(metadata.weights, np.float64)
+            if metadata.weights is not None
+            else None
+        )
+        self.sum_weights = (
+            float(np.sum(self.weights)) if self.weights is not None else float(num_data)
+        )
+
+    def eval(self, score: np.ndarray, objective=None) -> List[Tuple[str, float]]:
+        raise NotImplementedError
+
+
+def convert_scores(score: np.ndarray, objective) -> np.ndarray:
+    """Apply the objective's ConvertOutput host-side (sigmoid/softmax)."""
+    if objective is None:
+        return score
+    import numpy as _np
+
+    return _np.asarray(objective.convert_output(score), _np.float64)

@@ -1,0 +1,84 @@
+"""Binary metrics + AUC — parity with src/metric/binary_metric.hpp
+(logloss:113, error:137, AUC:157-262).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import Metric, convert_scores
+
+_EPS = 1e-15
+
+
+class BinaryLoglossMetric(Metric):
+    name = "binary_logloss"
+    bigger_is_better = False
+
+    def __init__(self, config):
+        pass
+
+    def eval(self, score, objective=None):
+        prob = convert_scores(np.asarray(score, np.float64), objective)
+        lab_pos = self.label > 0
+        p = np.where(lab_pos, prob, 1.0 - prob)
+        pt = -np.log(np.maximum(p, _EPS))
+        if self.weights is not None:
+            pt = pt * self.weights
+        return [(self.name, float(np.sum(pt) / self.sum_weights))]
+
+
+class BinaryErrorMetric(Metric):
+    name = "binary_error"
+    bigger_is_better = False
+
+    def __init__(self, config):
+        pass
+
+    def eval(self, score, objective=None):
+        prob = convert_scores(np.asarray(score, np.float64), objective)
+        # LossOnPoint (binary_metric.hpp:141-147): prob<=0.5 counts as
+        # predicting negative
+        err = np.where(prob <= 0.5, self.label > 0, self.label <= 0).astype(np.float64)
+        if self.weights is not None:
+            err = err * self.weights
+        return [(self.name, float(np.sum(err) / self.sum_weights))]
+
+
+class AUCMetric(Metric):
+    """Threshold-sweep AUC with tie grouping (binary_metric.hpp:193-259);
+    raw scores — no sigmoid needed (monotone)."""
+
+    name = "auc"
+    bigger_is_better = True
+
+    def __init__(self, config):
+        pass
+
+    def eval(self, score, objective=None):
+        score = np.asarray(score, np.float64)
+        order = np.argsort(-score, kind="mergesort")
+        s = score[order]
+        lab = self.label[order]
+        w = self.weights[order] if self.weights is not None else np.ones_like(lab)
+        pos = (lab > 0) * w
+        neg = (lab <= 0) * w
+        # group ties: segment boundaries where the score changes
+        new_thr = np.empty(len(s), dtype=bool)
+        if len(s):
+            new_thr[0] = True
+            new_thr[1:] = s[1:] != s[:-1]
+        seg = np.cumsum(new_thr) - 1  # tie-group id per row
+        nseg = seg[-1] + 1 if len(s) else 0
+        pos_per = np.zeros(nseg)
+        neg_per = np.zeros(nseg)
+        np.add.at(pos_per, seg, pos)
+        np.add.at(neg_per, seg, neg)
+        # accum += cur_neg * (cur_pos*0.5 + sum_pos_before)
+        sum_pos_before = np.concatenate([[0.0], np.cumsum(pos_per)[:-1]])
+        accum = float(np.sum(neg_per * (pos_per * 0.5 + sum_pos_before)))
+        sum_pos = float(np.sum(pos_per))
+        auc = 1.0
+        if sum_pos > 0.0 and sum_pos != self.sum_weights:
+            auc = accum / (sum_pos * (self.sum_weights - sum_pos))
+        return [(self.name, auc)]

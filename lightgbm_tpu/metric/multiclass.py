@@ -1,0 +1,53 @@
+"""Multiclass metrics — parity with src/metric/multiclass_metric.hpp
+(error:132, logloss:152).  Score layout (K, N).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import Metric, convert_scores
+
+_EPS = 1e-15
+
+
+class _MulticlassMetric(Metric):
+    bigger_is_better = False
+
+    def __init__(self, config):
+        self.num_class = int(config.num_class)
+
+    def eval(self, score, objective=None):
+        score = np.asarray(score, np.float64)
+        if score.ndim == 1:
+            score = score.reshape(self.num_class, -1)
+        prob = convert_scores(score, objective)
+        pt = self.loss(self.label, prob)
+        if self.weights is not None:
+            pt = pt * self.weights
+        return [(self.name, float(np.sum(pt) / self.sum_weights))]
+
+
+class MultiErrorMetric(_MulticlassMetric):
+    """1 when any other class's score >= the true class's
+    (multiclass_metric.hpp:136-144)."""
+
+    name = "multi_error"
+
+    def loss(self, label, prob):
+        k = label.astype(np.int64)
+        n = prob.shape[1]
+        true_score = prob[k, np.arange(n)]
+        # ties on the true class count as errors (>=, excluding itself)
+        ge = prob >= true_score[None, :]
+        ge[k, np.arange(n)] = False
+        return np.any(ge, axis=0).astype(np.float64)
+
+
+class MultiLoglossMetric(_MulticlassMetric):
+    name = "multi_logloss"
+
+    def loss(self, label, prob):
+        k = label.astype(np.int64)
+        p = prob[k, np.arange(prob.shape[1])]
+        return -np.log(np.maximum(p, _EPS))

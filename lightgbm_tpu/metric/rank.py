@@ -1,0 +1,122 @@
+"""Ranking metrics NDCG@k and MAP@k — parity with
+src/metric/rank_metric.hpp:16 / map_metric.hpp:16 and DCGCalculator
+(src/metric/dcg_calculator.cpp).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..objective.rank import dcg_discounts, default_label_gain
+from .base import Metric
+
+
+class NDCGMetric(Metric):
+    name = "ndcg"
+    bigger_is_better = True
+
+    def __init__(self, config):
+        self.eval_at = [int(k) for k in (config.ndcg_eval_at or [1, 2, 3, 4, 5])]
+        lg = config.label_gain
+        self.label_gain = np.asarray(lg, np.float64) if lg else default_label_gain()
+
+    def init(self, metadata, num_data: int) -> None:
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            from ..utils.log import Log
+
+            Log.fatal("For NDCG metric, there should be query information")
+        self.qb = np.asarray(metadata.query_boundaries, np.int64)
+        self.num_queries = len(self.qb) - 1
+        self.query_weights = metadata.query_weights
+        self.sum_query_weights = (
+            float(np.sum(self.query_weights))
+            if self.query_weights is not None
+            else float(self.num_queries)
+        )
+        # per-query ideal DCG at each k (CalMaxDCG, dcg_calculator.cpp:53-84)
+        self.inv_max_dcg = np.zeros((self.num_queries, len(self.eval_at)))
+        for i in range(self.num_queries):
+            lab = self.label[self.qb[i]: self.qb[i + 1]]
+            gains = np.sort(self.label_gain[lab.astype(np.int64)])[::-1]
+            disc = dcg_discounts(len(lab))
+            cum = np.cumsum(gains * disc)
+            for j, k in enumerate(self.eval_at):
+                kk = min(k, len(lab))
+                m = cum[kk - 1] if kk > 0 else 0.0
+                self.inv_max_dcg[i, j] = 1.0 / m if m > 0.0 else -1.0
+
+    def eval(self, score, objective=None):
+        score = np.asarray(score, np.float64)
+        sums = np.zeros(len(self.eval_at))
+        for i in range(self.num_queries):
+            lab = self.label[self.qb[i]: self.qb[i + 1]]
+            sc = score[self.qb[i]: self.qb[i + 1]]
+            qw = float(self.query_weights[i]) if self.query_weights is not None else 1.0
+            if self.inv_max_dcg[i, 0] <= 0.0:
+                # all-negative query counts as NDCG=1 (rank_metric.hpp:95-99)
+                sums += qw
+                continue
+            order = np.argsort(-sc, kind="mergesort")
+            gains = self.label_gain[lab[order].astype(np.int64)]
+            disc = dcg_discounts(len(lab))
+            cum = np.cumsum(gains * disc)
+            for j, k in enumerate(self.eval_at):
+                kk = min(k, len(lab))
+                dcg = cum[kk - 1] if kk > 0 else 0.0
+                sums[j] += qw * dcg * self.inv_max_dcg[i, j]
+        return [
+            (f"ndcg@{k}", float(sums[j] / self.sum_query_weights))
+            for j, k in enumerate(self.eval_at)
+        ]
+
+
+class MapMetric(Metric):
+    name = "map"
+    bigger_is_better = True
+
+    def __init__(self, config):
+        self.eval_at = [int(k) for k in (config.ndcg_eval_at or [1, 2, 3, 4, 5])]
+
+    def init(self, metadata, num_data: int) -> None:
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            from ..utils.log import Log
+
+            Log.fatal("For MAP metric, there should be query information")
+        self.qb = np.asarray(metadata.query_boundaries, np.int64)
+        self.num_queries = len(self.qb) - 1
+        self.query_weights = metadata.query_weights
+        self.sum_query_weights = (
+            float(np.sum(self.query_weights))
+            if self.query_weights is not None
+            else float(self.num_queries)
+        )
+
+    def eval(self, score, objective=None):
+        """CalMapAtK (map_metric.hpp:69-95) per query, averaged."""
+        score = np.asarray(score, np.float64)
+        sums = np.zeros(len(self.eval_at))
+        for i in range(self.num_queries):
+            lab = self.label[self.qb[i]: self.qb[i + 1]]
+            sc = score[self.qb[i]: self.qb[i + 1]]
+            qw = float(self.query_weights[i]) if self.query_weights is not None else 1.0
+            order = np.argsort(-sc, kind="mergesort")
+            hits = lab[order] > 0.5
+            num_hit = 0
+            sum_ap = 0.0
+            cur_left = 0
+            for j, k in enumerate(self.eval_at):
+                kk = min(k, len(lab))
+                for pos in range(cur_left, kk):
+                    if hits[pos]:
+                        num_hit += 1
+                        # reference quirk (map_metric.hpp:88): divides by the
+                        # eval_at slot index + 1, not the rank position
+                        sum_ap += num_hit / (j + 1.0)
+                sums[j] += qw * (sum_ap / kk if kk > 0 else 0.0)
+                cur_left = kk
+        return [
+            (f"map@{k}", float(sums[j] / self.sum_query_weights))
+            for j, k in enumerate(self.eval_at)
+        ]

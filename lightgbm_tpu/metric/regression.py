@@ -1,0 +1,93 @@
+"""Regression metrics — parity with src/metric/regression_metric.hpp
+(RMSE:115, L2:134, L1:153, Huber:166, Fair:188, Poisson:205).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import Metric, convert_scores
+
+_EPS = 1e-15
+
+
+class _RegressionMetric(Metric):
+    bigger_is_better = False
+
+    def __init__(self, config):
+        self.huber_delta = float(config.huber_delta)
+        self.fair_c = float(config.fair_c)
+
+    def loss(self, label, score):
+        raise NotImplementedError
+
+    def average(self, sum_loss, sum_weights):
+        return sum_loss / sum_weights
+
+    def eval(self, score, objective=None):
+        score = convert_scores(np.asarray(score, np.float64), objective)
+        pt = self.loss(self.label, score)
+        if self.weights is not None:
+            pt = pt * self.weights
+        return [(self.name, float(self.average(float(np.sum(pt)), self.sum_weights)))]
+
+
+class L2Metric(_RegressionMetric):
+    name = "l2"
+
+    def loss(self, label, score):
+        d = score - label
+        return d * d
+
+
+class RMSEMetric(L2Metric):
+    name = "rmse"
+
+    def average(self, sum_loss, sum_weights):
+        return np.sqrt(sum_loss / sum_weights)
+
+
+class L1Metric(_RegressionMetric):
+    name = "l1"
+
+    def loss(self, label, score):
+        return np.abs(score - label)
+
+
+class HuberMetric(_RegressionMetric):
+    """0.5*d^2 inside delta, delta*(|d| - 0.5*delta) outside
+    (regression_metric.hpp:166-185)."""
+
+    name = "huber"
+
+    def loss(self, label, score):
+        d = score - label
+        ad = np.abs(d)
+        return np.where(
+            ad <= self.huber_delta,
+            0.5 * d * d,
+            self.huber_delta * (ad - 0.5 * self.huber_delta),
+        )
+
+
+class FairMetric(_RegressionMetric):
+    """c^2 * (|d|/c - log(1 + |d|/c)) (regression_metric.hpp:188-202)."""
+
+    name = "fair"
+
+    def loss(self, label, score):
+        x = np.abs(score - label)
+        c = self.fair_c
+        return c * x - c * c * np.log1p(x / c)
+
+
+class PoissonMetric(_RegressionMetric):
+    """score - label*log(score) with eps floor
+    (regression_metric.hpp:205-226)."""
+
+    name = "poisson"
+
+    def loss(self, label, score):
+        eps = 1e-10
+        s = np.where(score < eps, eps, score)
+        return s - label * np.log(s)

@@ -1,0 +1,8 @@
+"""Tree model layer — counterpart of src/io/tree.cpp +
+include/LightGBM/tree.h.
+"""
+
+from .tree import Tree
+from .ensemble import stack_trees
+
+__all__ = ["Tree", "stack_trees"]

@@ -1,0 +1,74 @@
+"""Stacking host Trees into device SoA arrays for batched prediction
+(ops/predict.py).  Counterpart of the per-tree loops in
+GBDT::PredictRaw/Predict (src/boosting/gbdt_prediction.cpp) — here all
+trees traverse in one vmapped program.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def stack_trees(trees: List) -> dict:
+    """Pad T trees to (T, M)/(T, L) arrays.  Unused node slots point at
+    leaf 0; a 1-leaf tree gets a sentinel node routing everything to its
+    single leaf."""
+    t = len(trees)
+    m = max(max((tr.num_leaves - 1 for tr in trees), default=1), 1)
+    L = max(max((tr.num_leaves for tr in trees), default=1), 1)
+
+    def zf(shape, dtype):
+        return np.zeros(shape, dtype)
+
+    split_feature = zf((t, m), np.int32)
+    split_feature_inner = zf((t, m), np.int32)
+    threshold_bin = zf((t, m), np.int32)
+    threshold_real = zf((t, m), np.float32)
+    zero_bin = zf((t, m), np.int32)
+    dbz = zf((t, m), np.int32)
+    default_value = zf((t, m), np.float32)
+    is_cat = zf((t, m), np.bool_)
+    left = np.full((t, m), -1, np.int32)
+    right = np.full((t, m), -1, np.int32)
+    leaf_value = zf((t, L), np.float32)
+
+    for i, tr in enumerate(trees):
+        n = tr.num_leaves
+        if n <= 1:
+            # sentinel: node 0 sends every row to leaf 0
+            threshold_real[i, 0] = np.inf
+            threshold_bin[i, 0] = np.iinfo(np.int32).max
+            left[i, 0] = -1  # ~0
+            right[i, 0] = -1
+            leaf_value[i, 0] = tr.leaf_value[0]
+            continue
+        k = n - 1
+        f32max = np.finfo(np.float32).max
+        split_feature[i, :k] = tr.split_feature[:k]
+        split_feature_inner[i, :k] = tr.split_feature_inner[:k]
+        threshold_bin[i, :k] = tr.threshold_in_bin[:k]
+        threshold_real[i, :k] = np.clip(tr.threshold[:k], -f32max, f32max)
+        zero_bin[i, :k] = tr.zero_bin[:k]
+        dbz[i, :k] = tr.default_bin_for_zero[:k]
+        default_value[i, :k] = np.clip(tr.default_value[:k], -f32max, f32max)
+        is_cat[i, :k] = tr.decision_type[:k] == 1
+        left[i, :k] = tr.left_child[:k]
+        right[i, :k] = tr.right_child[:k]
+        leaf_value[i, :n] = tr.leaf_value[:n]
+
+    return {
+        "split_feature": jnp.asarray(split_feature),
+        "split_feature_inner": jnp.asarray(split_feature_inner),
+        "threshold_bin": jnp.asarray(threshold_bin),
+        "threshold_real": jnp.asarray(threshold_real),
+        "zero_bin": jnp.asarray(zero_bin),
+        "default_bin_for_zero": jnp.asarray(dbz),
+        "default_value": jnp.asarray(default_value),
+        "is_categorical": jnp.asarray(is_cat),
+        "left_child": jnp.asarray(left),
+        "right_child": jnp.asarray(right),
+        "leaf_value": jnp.asarray(leaf_value),
+    }

@@ -1,0 +1,72 @@
+"""Objective functions — counterpart of src/objective/ (factory at
+objective_function.cpp:9-56).
+
+TPU-first design: ``GetGradients`` is a pure jnp function evaluated on
+device inside the boosting step; labels/weights live in HBM as jnp arrays.
+The reference's OpenMP elementwise loops become vectorized expressions;
+lambdarank's per-query pairwise loop becomes a vmapped padded-matrix
+computation (ops in rank.py).
+"""
+
+from .base import ObjectiveFunction
+from .regression import (
+    RegressionL2Loss,
+    RegressionL1Loss,
+    RegressionHuberLoss,
+    RegressionFairLoss,
+    RegressionPoissonLoss,
+)
+from .binary import BinaryLogloss
+from .multiclass import MulticlassSoftmax, MulticlassOVA
+from .rank import LambdarankNDCG
+
+_FACTORY = {
+    "regression": RegressionL2Loss,
+    "regression_l2": RegressionL2Loss,
+    "mean_squared_error": RegressionL2Loss,
+    "mse": RegressionL2Loss,
+    "l2": RegressionL2Loss,
+    "regression_l1": RegressionL1Loss,
+    "mean_absolute_error": RegressionL1Loss,
+    "mae": RegressionL1Loss,
+    "l1": RegressionL1Loss,
+    "huber": RegressionHuberLoss,
+    "fair": RegressionFairLoss,
+    "poisson": RegressionPoissonLoss,
+    "binary": BinaryLogloss,
+    "lambdarank": LambdarankNDCG,
+    "multiclass": MulticlassSoftmax,
+    "softmax": MulticlassSoftmax,
+    "multiclassova": MulticlassOVA,
+    "multiclass_ova": MulticlassOVA,
+    "ova": MulticlassOVA,
+    "ovr": MulticlassOVA,
+}
+
+
+def create_objective(config) -> ObjectiveFunction:
+    """ObjectiveFunction::CreateObjectiveFunction
+    (src/objective/objective_function.cpp:9-56)."""
+    from ..utils.log import Log
+
+    name = config.objective.lower()
+    if name in ("none", "null", "custom", ""):
+        return None
+    if name not in _FACTORY:
+        Log.fatal("Unknown objective type name: %s", name)
+    return _FACTORY[name](config)
+
+
+__all__ = [
+    "ObjectiveFunction",
+    "create_objective",
+    "RegressionL2Loss",
+    "RegressionL1Loss",
+    "RegressionHuberLoss",
+    "RegressionFairLoss",
+    "RegressionPoissonLoss",
+    "BinaryLogloss",
+    "MulticlassSoftmax",
+    "MulticlassOVA",
+    "LambdarankNDCG",
+]

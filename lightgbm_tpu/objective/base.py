@@ -1,0 +1,60 @@
+"""Abstract objective interface (include/LightGBM/objective_function.h)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class ObjectiveFunction:
+    """Mirrors the reference's ObjectiveFunction virtuals.
+
+    ``get_gradients(score) -> (grad, hess)`` is a pure jnp function: score
+    is ``(N,)`` (or ``(K, N)`` for multiclass), outputs match its shape.
+    It is safe to close over in a jitted training step.
+    """
+
+    name = "none"
+
+    def init(self, metadata, num_data: int) -> None:
+        """Bind label/weight device arrays (ObjectiveFunction::Init)."""
+        import numpy as np
+
+        self.num_data = num_data
+        self.label = jnp.asarray(np.asarray(metadata.label, np.float32))
+        self.weights = (
+            jnp.asarray(np.asarray(metadata.weights, np.float32))
+            if metadata.weights is not None
+            else None
+        )
+
+    def get_gradients(self, score):
+        raise NotImplementedError
+
+    def convert_output(self, score):
+        """Raw score -> prediction space (ConvertOutput); identity default."""
+        return score
+
+    @property
+    def num_tree_per_iteration(self) -> int:
+        return 1
+
+    @property
+    def num_predict_one_row(self) -> int:
+        return 1
+
+    @property
+    def is_constant_hessian(self) -> bool:
+        return False
+
+    @property
+    def boost_from_average(self) -> bool:
+        return False
+
+    def to_string(self) -> str:
+        """Objective line of the model file (ToString)."""
+        return self.name
+
+    def _apply_weights(self, grad, hess):
+        if self.weights is not None:
+            return grad * self.weights, hess * self.weights
+        return grad, hess

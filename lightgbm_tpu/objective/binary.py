@@ -1,0 +1,60 @@
+"""Binary logloss objective — parity with
+src/objective/binary_objective.hpp:13-154.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.log import Log
+from .base import ObjectiveFunction
+
+
+class BinaryLogloss(ObjectiveFunction):
+    name = "binary"
+
+    def __init__(self, config, is_pos=None):
+        self.is_unbalance = bool(config.is_unbalance)
+        self.sigmoid = float(config.sigmoid)
+        if self.sigmoid <= 0.0:
+            Log.fatal("Sigmoid parameter %f should be greater than zero", self.sigmoid)
+        self.scale_pos_weight = float(config.scale_pos_weight)
+        self._is_pos = is_pos if is_pos is not None else (lambda lab: lab > 0)
+
+    def init(self, metadata, num_data: int) -> None:
+        super().init(metadata, num_data)
+        lab = np.asarray(metadata.label, np.float32)
+        pos_mask = self._is_pos(lab)
+        cnt_positive = int(np.sum(pos_mask))
+        cnt_negative = num_data - cnt_positive
+        if cnt_positive == 0 or cnt_negative == 0:
+            Log.warning("Only contain one class.")
+            self.num_data = 0  # "not need to boost" (hpp:61-64)
+        Log.info("Number of positive: %d, number of negative: %d", cnt_positive, cnt_negative)
+        # +-1 label values and per-class weights (hpp:67-84)
+        weight_pos, weight_neg = 1.0, 1.0
+        if self.is_unbalance and cnt_positive > 0 and cnt_negative > 0:
+            if cnt_positive > cnt_negative:
+                weight_neg = cnt_positive / cnt_negative
+            else:
+                weight_pos = cnt_negative / cnt_positive
+        weight_pos *= self.scale_pos_weight
+        self.sign = jnp.asarray(np.where(pos_mask, 1.0, -1.0).astype(np.float32))
+        self.label_weight = jnp.asarray(
+            np.where(pos_mask, weight_pos, weight_neg).astype(np.float32)
+        )
+
+    def get_gradients(self, score):
+        # response = -y*sig / (1 + exp(y*sig*score)) (hpp:95-99)
+        response = -self.sign * self.sigmoid / (1.0 + jnp.exp(self.sign * self.sigmoid * score))
+        abs_response = jnp.abs(response)
+        grad = response * self.label_weight
+        hess = abs_response * (self.sigmoid - abs_response) * self.label_weight
+        return self._apply_weights(grad, hess)
+
+    def convert_output(self, score):
+        return 1.0 / (1.0 + jnp.exp(-self.sigmoid * score))
+
+    def to_string(self) -> str:
+        return f"{self.name} sigmoid:{self.sigmoid:g}"

@@ -1,0 +1,161 @@
+"""LambdarankNDCG objective — parity with
+src/objective/rank_objective.hpp:19-244 (pair loop at 115-160).
+
+TPU-first design: the reference walks each query's sorted docs with a
+nested pairwise loop under OpenMP.  Here queries are padded to the max
+query length S and vmapped: per query an (S, S) pairwise lambda matrix is
+formed over the score-sorted docs, masked to (high_label > low_label)
+pairs, row/column-reduced, and scattered back to document order.  All
+queries evaluate as one (Q, S, S) batched program on the VPU — no ragged
+shapes, no host loop.
+
+The sigmoid lookup table (ConstructSigmoidTable, hpp:187-201) is replaced
+by computing 2/(1+exp(2*sigmoid*x)) directly — on TPU the transcendental
+is cheaper than a 1M-entry gather.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.log import Log
+from .base import ObjectiveFunction
+
+
+def default_label_gain(max_label: int = 31) -> np.ndarray:
+    """label_gain = 2^i - 1 (config.cpp:271-277)."""
+    g = [0.0] + [float((1 << i) - 1) for i in range(1, max_label)]
+    return np.asarray(g, dtype=np.float64)
+
+
+def dcg_discounts(max_position: int) -> np.ndarray:
+    """discount[i] = 1/log2(2+i) (dcg_calculator.cpp:23-26)."""
+    return 1.0 / np.log2(2.0 + np.arange(max_position, dtype=np.float64))
+
+
+def max_dcg_at_k(k: int, labels: np.ndarray, label_gain: np.ndarray) -> float:
+    """DCGCalculator::CalMaxDCGAtK (dcg_calculator.cpp:28-50): ideal DCG
+    from sorted label counts."""
+    k = min(k, len(labels))
+    gains = np.sort(label_gain[labels.astype(np.int64)])[::-1][:k]
+    disc = dcg_discounts(k)
+    return float(np.sum(gains * disc[: len(gains)]))
+
+
+def pad_queries(query_boundaries: np.ndarray):
+    """(Q, S) padded doc-index matrix + (Q, S) valid mask + (Q,) counts."""
+    q = len(query_boundaries) - 1
+    sizes = np.diff(query_boundaries)
+    s = int(sizes.max()) if q else 1
+    doc_idx = np.zeros((q, s), dtype=np.int32)
+    valid = np.zeros((q, s), dtype=bool)
+    for i in range(q):
+        c = sizes[i]
+        doc_idx[i, :c] = np.arange(query_boundaries[i], query_boundaries[i + 1])
+        valid[i, :c] = True
+    return doc_idx, valid, sizes.astype(np.int32)
+
+
+class LambdarankNDCG(ObjectiveFunction):
+    name = "lambdarank"
+
+    def __init__(self, config):
+        self.sigmoid = float(config.sigmoid)
+        if self.sigmoid <= 0.0:
+            Log.fatal("Sigmoid param %f should be greater than zero", self.sigmoid)
+        self.optimize_pos_at = int(config.max_position)
+        lg = config.label_gain
+        self.label_gain = (
+            np.asarray(lg, np.float64) if lg else default_label_gain()
+        )
+
+    def init(self, metadata, num_data: int) -> None:
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            Log.fatal("Lambdarank tasks require query information")
+        qb = np.asarray(metadata.query_boundaries, np.int64)
+        lab = np.asarray(metadata.label, np.float32)
+        self.num_queries = len(qb) - 1
+        doc_idx, valid, sizes = pad_queries(qb)
+        s = doc_idx.shape[1]
+        # inverse max DCG per query (hpp:58-69)
+        inv = np.zeros(self.num_queries, np.float64)
+        for i in range(self.num_queries):
+            m = max_dcg_at_k(self.optimize_pos_at, lab[qb[i]: qb[i + 1]], self.label_gain)
+            inv[i] = 1.0 / m if m > 0.0 else 0.0
+        self.doc_idx = jnp.asarray(doc_idx)
+        self.valid = jnp.asarray(valid)
+        self.inverse_max_dcg = jnp.asarray(inv.astype(np.float32))
+        self.gain_of_doc = jnp.asarray(
+            self.label_gain[lab.astype(np.int64)].astype(np.float32)
+        )
+        self.discount = jnp.asarray(dcg_discounts(s).astype(np.float32))
+
+    # ------------------------------------------------------------------
+    def _one_query(self, score_q, label_q, gain_q, valid_q, inv_max_dcg):
+        """(S,) padded arrays -> (S,) lambdas/hessians in padded doc order.
+
+        Mirrors GetGradientsForOneQuery (hpp:85-170) with the pair loop as
+        an (S, S) matrix; [i] indexes sorted position, high along rows.
+        """
+        s = score_q.shape[0]
+        neg_inf = jnp.float32(-jnp.inf)
+        skey = jnp.where(valid_q, score_q, neg_inf)
+        order = jnp.argsort(-skey)  # stable: score desc, pads last
+        sc = skey[order]
+        lb = label_q[order]
+        gains = gain_q[order]
+        vd = valid_q[order]
+        disc = self.discount[:s]
+
+        cnt = jnp.sum(vd.astype(jnp.int32))
+        best_score = sc[0]
+        worst_idx = jnp.maximum(cnt - 1, 0)
+        worst_score = sc[worst_idx]
+        score_spread = best_score != worst_score
+
+        # pairwise (high=i rows, low=j cols)
+        delta_score = sc[:, None] - sc[None, :]
+        dcg_gap = gains[:, None] - gains[None, :]
+        paired_discount = jnp.abs(disc[:, None] - disc[None, :])
+        delta_ndcg = dcg_gap * paired_discount * inv_max_dcg
+        # regularize by score distance (hpp:145-147)
+        delta_ndcg = jnp.where(
+            score_spread, delta_ndcg / (0.01 + jnp.abs(delta_score)), delta_ndcg
+        )
+        # GetSigmoid(delta) = 2/(1+exp(2*sigmoid*delta)) (hpp:197-200)
+        p_lambda = 2.0 / (1.0 + jnp.exp(2.0 * self.sigmoid * delta_score))
+        p_hessian = p_lambda * (2.0 - p_lambda)
+        lam = -delta_ndcg * p_lambda
+        hes = 2.0 * delta_ndcg * p_hessian
+
+        mask = (lb[:, None] > lb[None, :]) & vd[:, None] & vd[None, :]
+        lam = jnp.where(mask, lam, 0.0)
+        hes = jnp.where(mask, hes, 0.0)
+
+        lam_sorted = jnp.sum(lam, axis=1) - jnp.sum(lam, axis=0)
+        hes_sorted = jnp.sum(hes, axis=1) + jnp.sum(hes, axis=0)
+
+        # scatter back from sorted order to padded doc order
+        lam_doc = jnp.zeros(s).at[order].set(lam_sorted)
+        hes_doc = jnp.zeros(s).at[order].set(hes_sorted)
+        return lam_doc, hes_doc
+
+    def get_gradients(self, score):
+        sq = score[self.doc_idx]  # (Q, S)
+        lq = self.label[self.doc_idx]
+        gq = self.gain_of_doc[self.doc_idx]
+        lam, hes = jax.vmap(self._one_query)(
+            sq, lq, gq, self.valid, self.inverse_max_dcg
+        )
+        n = score.shape[0]
+        flat_idx = self.doc_idx.reshape(-1)
+        w = self.valid.reshape(-1).astype(score.dtype)
+        grad = jnp.zeros(n, score.dtype).at[flat_idx].add(lam.reshape(-1) * w)
+        hess = jnp.zeros(n, score.dtype).at[flat_idx].add(hes.reshape(-1) * w)
+        if self.weights is not None:
+            grad = grad * self.weights
+            hess = hess * self.weights
+        return grad, hess

@@ -1,0 +1,130 @@
+"""Regression objectives — parity with
+src/objective/regression_objective.hpp (L2:11-77, L1:78-145,
+Huber:147-232, Fair:236-295, Poisson:298-357) as jnp elementwise math.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from .base import ObjectiveFunction
+
+
+def _gaussian_hessian(score, label, grad, eta, w=1.0):
+    """Common::ApproximateHessianWithGaussian (utils/common.h:486-496)."""
+    diff = score - label
+    x = jnp.abs(diff)
+    a = 2.0 * jnp.abs(grad) * w
+    c = jnp.maximum((jnp.abs(score) + jnp.abs(label)) * eta, 1.0e-10)
+    return w * jnp.exp(-x * x / (2.0 * c * c)) * a / (c * math.sqrt(2.0 * math.pi))
+
+
+class RegressionL2Loss(ObjectiveFunction):
+    """grad = score - label, hess = 1 (regression_objective.hpp:29-44)."""
+
+    name = "regression"
+
+    def __init__(self, config):
+        pass
+
+    def get_gradients(self, score):
+        grad = score - self.label
+        hess = jnp.ones_like(score)
+        return self._apply_weights(grad, hess)
+
+    @property
+    def is_constant_hessian(self) -> bool:
+        return self.weights is None
+
+    @property
+    def boost_from_average(self) -> bool:
+        return True
+
+
+class RegressionL1Loss(ObjectiveFunction):
+    """grad = sign(diff), hess = Gaussian approximation scaled by
+    gaussian_eta (regression_objective.hpp:96-118)."""
+
+    name = "regression_l1"
+
+    def __init__(self, config):
+        self.eta = float(config.gaussian_eta)
+
+    def get_gradients(self, score):
+        diff = score - self.label
+        w = self.weights if self.weights is not None else 1.0
+        grad = jnp.where(diff >= 0.0, 1.0, -1.0) * w
+        hess = _gaussian_hessian(score, self.label, grad, self.eta, w)
+        return grad, hess
+
+    @property
+    def boost_from_average(self) -> bool:
+        return True
+
+
+class RegressionHuberLoss(ObjectiveFunction):
+    """Quadratic inside huber_delta, linear outside with Gaussian hessian
+    (regression_objective.hpp:169-206)."""
+
+    name = "huber"
+
+    def __init__(self, config):
+        self.delta = float(config.huber_delta)
+        self.eta = float(config.gaussian_eta)
+
+    def get_gradients(self, score):
+        diff = score - self.label
+        w = self.weights if self.weights is not None else 1.0
+        inside = jnp.abs(diff) <= self.delta
+        grad_out = jnp.where(diff >= 0.0, self.delta, -self.delta) * w
+        hess_out = _gaussian_hessian(score, self.label, grad_out, self.eta, w)
+        grad = jnp.where(inside, diff * w, grad_out)
+        hess = jnp.where(inside, jnp.ones_like(score) * w, hess_out)
+        return grad, hess
+
+    @property
+    def boost_from_average(self) -> bool:
+        return True
+
+
+class RegressionFairLoss(ObjectiveFunction):
+    """grad = c*x/(|x|+c), hess = c^2/(|x|+c)^2
+    (regression_objective.hpp:254-272)."""
+
+    name = "fair"
+
+    def __init__(self, config):
+        self.c = float(config.fair_c)
+
+    def get_gradients(self, score):
+        x = score - self.label
+        ax_c = jnp.abs(x) + self.c
+        grad = self.c * x / ax_c
+        hess = self.c * self.c / (ax_c * ax_c)
+        return self._apply_weights(grad, hess)
+
+    @property
+    def boost_from_average(self) -> bool:
+        return True
+
+
+class RegressionPoissonLoss(ObjectiveFunction):
+    """grad = score - label, hess = score + poisson_max_delta_step —
+    the reference's raw-score-space Poisson
+    (regression_objective.hpp:319-337)."""
+
+    name = "poisson"
+
+    def __init__(self, config):
+        self.max_delta_step = float(config.poisson_max_delta_step)
+
+    def get_gradients(self, score):
+        grad = score - self.label
+        hess = score + self.max_delta_step
+        return self._apply_weights(grad, hess)
+
+    @property
+    def boost_from_average(self) -> bool:
+        return True

@@ -1,8 +1,25 @@
 """Leaf-wise tree growth under jit — counterpart of
 SerialTreeLearner::Train (src/treelearner/serial_tree_learner.cpp:152-207)
-plus DataPartition (data_partition.hpp) and the histogram pool.
+plus DataPartition (data_partition.hpp) and the histogram pool, with the
+reference's three parallel learners folded in as collective hooks:
 
-TPU-first redesign:
+- ``parallel="serial"``  — single-chip (SerialTreeLearner).
+- ``parallel="data"``    — rows sharded over ``axis_name``; local
+  histograms psum'd so every shard sees the global (F, B, 3) tensor and
+  derives the identical split (DataParallelTreeLearner,
+  data_parallel_tree_learner.cpp:148-248 — the ReduceScatter+Allreduce
+  pair collapses to one XLA psum over ICI).
+- ``parallel="feature"`` — rows replicated, feature *search* sharded by a
+  per-shard feature mask; per-shard best splits argmax'd across the mesh
+  (FeatureParallelTreeLearner, feature_parallel_tree_learner.cpp:31-79 —
+  the SplitInfo::MaxReducer Allreduce becomes all_gather + argmax).
+- ``parallel="voting"``  — rows sharded; each shard proposes its local
+  top-2k features, a global vote picks top-k, and only those features'
+  histograms are psum'd (VotingParallelTreeLearner,
+  voting_parallel_tree_learner.cpp:54-56,164-350 — top-k histogram
+  compression for bandwidth-bound meshes).
+
+TPU-first redesign (vs the reference's index lists):
 - The per-leaf index lists of DataPartition become one flat ``leaf_id[N]``
   vector updated by a predicate on the split feature's bin column
   (partition-by-predicate: O(N) per split, no index shuffling, static
@@ -15,10 +32,6 @@ TPU-first redesign:
   per-leaf best-split table (best_split_per_leaf_); each iteration splits
   the argmax-gain leaf and recomputes best splits only for the two
   children, exactly like the reference.
-- The reference's BeforeFindBestSplit data-count gate (both children
-  < 2*min_data_in_leaf) is subsumed by the in-scan min_data masks — a leaf
-  with cnt < 2*min_data can never satisfy min_data on both sides — so only
-  the max_depth gate is applied explicitly.
 
 Everything is static-shaped: one XLA compile per
 (N, F, B, num_leaves) configuration, reused across all boosting
@@ -38,7 +51,8 @@ from .split import (
     NEG_INF,
     FeatureMeta,
     SplitHyper,
-    best_split_all_features,
+    best_split_per_feature,
+    finalize_split,
     leaf_output,
 )
 
@@ -51,6 +65,10 @@ class GrowParams(NamedTuple):
     max_depth: int = -1
     use_missing: bool = True
     row_block: int = ROW_BLOCK
+    parallel: str = "serial"  # serial | data | feature | voting
+    axis_name: str = ""  # mesh axis name for the collectives
+    top_k: int = 20  # voting: top-k voted features (config top_k)
+    num_machines: int = 1  # voting: local-constraint scaling divisor
 
 
 class GrowResult(NamedTuple):
@@ -77,14 +95,15 @@ class _State(NamedTuple):
     num_splits: jnp.ndarray
     done: jnp.ndarray
     leaf_id: jnp.ndarray
-    pool: jnp.ndarray  # (L, F, B, 3)
+    pool: jnp.ndarray  # (L, F, B, 3) — global hist (serial/data/feature),
+    # LOCAL hist for voting (reduction deferred to the vote)
     # best_split_per_leaf_ table
     bs_gain: jnp.ndarray  # (L,)
     bs_feat: jnp.ndarray
     bs_thr: jnp.ndarray
     bs_dbz: jnp.ndarray
     bs_left: jnp.ndarray  # (L, 3) left (sum_g, sum_h, cnt)
-    # per-leaf totals & bookkeeping
+    # per-leaf totals & bookkeeping (GLOBAL sums in all modes)
     leaf_sum: jnp.ndarray  # (L, 3)
     leaf_value: jnp.ndarray  # (L,)
     leaf_cnt: jnp.ndarray  # (L,)
@@ -126,31 +145,90 @@ def grow_tree(
     hyper: SplitHyper,
     params: GrowParams,
 ) -> GrowResult:
-    """Grow one leaf-wise tree.  See module docstring."""
+    """Grow one leaf-wise tree.  See module docstring.
+
+    Under a parallel mode this must be called inside ``shard_map`` over a
+    mesh axis named ``params.axis_name`` (parallel/learner.py does this);
+    ``bins``/``grad``/``hess``/``select`` are then the per-shard blocks.
+    """
     n, f = bins.shape
     L = params.num_leaves
     B = params.num_bins
+    mode = params.parallel
+    ax = params.axis_name
 
     def hist_of(sel):
-        return build_histogram(bins, grad, hess, sel, B, params.row_block)
+        h = build_histogram(bins, grad, hess, sel, B, params.row_block)
+        if mode == "data":
+            h = jax.lax.psum(h, ax)
+        # voting keeps LOCAL histograms in the pool; serial/feature are
+        # already global (feature mode replicates rows)
+        return h
+
+    def global_sums(tg, th, tc):
+        if mode in ("data", "voting"):
+            tg = jax.lax.psum(tg, ax)
+            th = jax.lax.psum(th, ax)
+            tc = jax.lax.psum(tc, ax)
+        return tg, th, tc
 
     def find_best(hist, sums, depth_ok):
-        res = best_split_all_features(
-            hist, sums[0], sums[1], sums[2], meta, hyper, feature_mask,
-            use_missing=params.use_missing,
-        )
+        """hist: pool entry (global for serial/data/feature, local for
+        voting); sums: GLOBAL leaf totals."""
+        sg, sh, sc = sums[0], sums[1], sums[2]
+        if mode == "voting":
+            # 1. local proposals from LOCAL hist with /num_machines
+            #    constraints (voting_parallel_tree_learner.cpp:54-56)
+            local_tot = jnp.sum(hist[0], axis=0)  # (3,): identical per f
+            local_hyper = hyper._replace(
+                min_data_in_leaf=hyper.min_data_in_leaf / params.num_machines,
+                min_sum_hessian_in_leaf=hyper.min_sum_hessian_in_leaf
+                / params.num_machines,
+            )
+            lg_f, _, _, _ = best_split_per_feature(
+                hist, local_tot[0], local_tot[1], local_tot[2],
+                meta, local_hyper, feature_mask, params.use_missing,
+            )
+            k2 = min(2 * params.top_k, f)
+            _, top2k = jax.lax.top_k(lg_f, k2)
+            # 2. global vote (GlobalVoting, :164-195): count proposals
+            votes = jnp.zeros((f,), jnp.float32).at[top2k].add(1.0)
+            votes = jax.lax.psum(votes, ax)
+            # stable tie-break toward lower feature index
+            k1 = min(params.top_k, f)
+            _, voted = jax.lax.top_k(votes - jnp.arange(f) * 1e-6, k1)
+            voted_mask = jnp.zeros((f,), jnp.float32).at[voted].set(1.0)
+            # 3. reduce only the voted features' histograms
+            #    (CopyLocalHistogram + ReduceScatter, :196-350)
+            hist_voted = jax.lax.psum(hist * voted_mask[:, None, None], ax)
+            gain_f, thr_f, dbz_f, left_f = best_split_per_feature(
+                hist_voted, sg, sh, sc, meta, hyper,
+                feature_mask * voted_mask, params.use_missing,
+            )
+            res = finalize_split(gain_f, thr_f, dbz_f, left_f, sg, sh, sc, hyper)
+        else:
+            gain_f, thr_f, dbz_f, left_f = best_split_per_feature(
+                hist, sg, sh, sc, meta, hyper, feature_mask, params.use_missing
+            )
+            res = finalize_split(gain_f, thr_f, dbz_f, left_f, sg, sh, sc, hyper)
+            if mode == "feature":
+                # global best across feature shards: all_gather the scalar
+                # SplitInfo and take the max-gain shard (ties -> lowest
+                # shard, matching lowest feature index under contiguous
+                # feature sharding) — SplitInfo::MaxReducer Allreduce
+                all_res = jax.lax.all_gather(res, ax)
+                i = jnp.argmax(all_res.gain)
+                res = jax.tree_util.tree_map(lambda x: x[i], all_res)
         return res._replace(gain=jnp.where(depth_ok, res.gain, NEG_INF))
 
     # ---- root (BeforeTrain: LeafSplits::Init + root histogram)
     tg = jnp.sum(grad * select)
     th = jnp.sum(hess * select)
     tc = jnp.sum(select)
+    tg, th, tc = global_sums(tg, th, tc)
     root_hist = hist_of(select)
     root_sums = jnp.stack([tg, th, tc])
-    root_depth_ok = (params.max_depth <= 0) or True  # root depth 0 < any max_depth >= 1
-    root_res = best_split_all_features(
-        root_hist, tg, th, tc, meta, hyper, feature_mask, use_missing=params.use_missing
-    )
+    root_res = find_best(root_hist, root_sums, jnp.array(True))
 
     zi = jnp.zeros((L,), jnp.int32)
     zf = jnp.zeros((L,))
@@ -175,7 +253,6 @@ def grow_tree(
         rec_internal_value=zr,
     )
     st = _store_split(st, 0, root_res)
-    del root_depth_ok
 
     def cond(st: _State):
         return (~st.done) & (st.num_splits < L - 1)
@@ -195,7 +272,7 @@ def grow_tree(
         thr = st.bs_thr[bl]
         dbz = st.bs_dbz[bl]
         gain = st.bs_gain[bl]
-        left = st.bs_left[bl]  # (3,)
+        left = st.bs_left[bl]  # (3,) GLOBAL left sums
         totals = st.leaf_sum[bl]
         right = totals - left
         lg, lh, lc = left[0], left[1], left[2]

@@ -110,7 +110,7 @@ def _argmax_prefer_high(x):
     return n - 1 - jnp.argmax(x[..., ::-1], axis=-1)
 
 
-def best_split_all_features(
+def best_split_per_feature(
     hist: jnp.ndarray,
     sum_g: jnp.ndarray,
     sum_h: jnp.ndarray,
@@ -119,8 +119,11 @@ def best_split_all_features(
     hyper: SplitHyper,
     feature_mask: jnp.ndarray,
     use_missing: bool = True,
-) -> SplitResult:
-    """Best split across every feature for one leaf.
+):
+    """Per-feature best split: returns (gain_f, thr_f, dbz_f, left_f) with
+    shapes (F,), (F,), (F,), (F, 3).  The per-feature half of
+    FindBestThresholds — exposed separately so the parallel learners can
+    vote / reduce over features before the global argmax.
 
     hist : (F, B, 3) f32 histogram of (sum_g, sum_h, cnt) per bin.
     sum_g/sum_h/num_data : leaf totals (LeafSplits snapshot) — used for the
@@ -223,19 +226,28 @@ def best_split_all_features(
     best_left_f = jnp.where(is_cat[:, None], cat_left, best_left_f)
 
     best_gain_f = jnp.where(feature_mask > 0, best_gain_f, NEG_INF)
+    # subtract the shift so gains are comparable across leaves/shards
+    best_gain_f = jnp.where(
+        jnp.isfinite(best_gain_f), best_gain_f - min_gain_shift, NEG_INF
+    )
+    return best_gain_f, best_thr_f, best_dbz_f, best_left_f
 
-    # across features: first max wins (ArrayArgs::ArgMax — lowest index)
-    fbest = jnp.argmax(best_gain_f).astype(jnp.int32)
-    gain = best_gain_f[fbest]
-    left = best_left_f[fbest]
+
+def finalize_split(gain_f, thr_f, dbz_f, left_f, sum_g, sum_h, num_data,
+                   hyper: SplitHyper) -> SplitResult:
+    """Global argmax over the per-feature arrays (ArrayArgs::ArgMax —
+    first/lowest index wins ties) and SplitInfo assembly."""
+    l1, l2 = hyper.lambda_l1, hyper.lambda_l2
+    fbest = jnp.argmax(gain_f).astype(jnp.int32)
+    gain = gain_f[fbest]
+    left = left_f[fbest]
     lg, lh, lc = left[0], left[1], left[2]
     rg, rh, rc = sum_g - lg, sum_h - lh, num_data - lc
-    has_split = jnp.isfinite(gain)
     return SplitResult(
-        gain=jnp.where(has_split, gain - min_gain_shift, NEG_INF),
+        gain=gain,
         feature=fbest,
-        threshold_bin=best_thr_f[fbest],
-        default_bin_for_zero=best_dbz_f[fbest],
+        threshold_bin=thr_f[fbest],
+        default_bin_for_zero=dbz_f[fbest],
         left_sum_g=lg,
         left_sum_h=lh,
         left_cnt=lc,
@@ -245,3 +257,21 @@ def best_split_all_features(
         left_output=leaf_output(lg, lh, l1, l2),
         right_output=leaf_output(rg, rh, l1, l2),
     )
+
+
+def best_split_all_features(
+    hist: jnp.ndarray,
+    sum_g: jnp.ndarray,
+    sum_h: jnp.ndarray,
+    num_data: jnp.ndarray,
+    meta: FeatureMeta,
+    hyper: SplitHyper,
+    feature_mask: jnp.ndarray,
+    use_missing: bool = True,
+) -> SplitResult:
+    """Best split across every feature for one leaf (per-feature scan +
+    global argmax)."""
+    gain_f, thr_f, dbz_f, left_f = best_split_per_feature(
+        hist, sum_g, sum_h, num_data, meta, hyper, feature_mask, use_missing
+    )
+    return finalize_split(gain_f, thr_f, dbz_f, left_f, sum_g, sum_h, num_data, hyper)

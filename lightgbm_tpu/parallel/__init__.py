@@ -1,0 +1,10 @@
+"""Distributed training over a jax.sharding.Mesh — the counterpart of the
+reference's src/network/ + parallel tree learners, rebuilt on XLA
+collectives over ICI/DCN (SURVEY §2.6: the Bruck/recursive-halving
+topology code is deleted outright; psum/all_gather/reduce_scatter already
+implement it in hardware).
+"""
+
+from .learner import ShardedLearner, make_mesh
+
+__all__ = ["ShardedLearner", "make_mesh"]

@@ -1,0 +1,123 @@
+"""Sharded tree learner — wraps ops/grow.py's collective-aware grower in
+``shard_map`` over a device mesh.
+
+Mode mapping (TreeLearner::CreateTreeLearner, tree_learner.cpp:9-33):
+  tree_learner=serial  -> plain jit (single shard)
+  tree_learner=data    -> rows sharded, histogram psum
+                          (DataParallelTreeLearner)
+  tree_learner=feature -> rows replicated, feature search sharded
+                          (FeatureParallelTreeLearner)
+  tree_learner=voting  -> rows sharded, top-k voted histogram reduction
+                          (VotingParallelTreeLearner)
+
+The mesh is one axis named "data"; multi-host meshes come from
+jax.distributed initialization upstream — the learner only sees the axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # JAX >= 0.4.35 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from ..ops.grow import GrowParams, GrowResult, grow_tree
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """One-axis ("data") mesh over the local devices."""
+    devs = jax.devices()
+    d = n_devices if n_devices is not None else len(devs)
+    return Mesh(np.array(devs[:d]), ("data",))
+
+
+def _shard_map_compat(fn, mesh, in_specs, out_specs):
+    """shard_map with replication checking off (the grower's collective
+    results are replicated by construction; the checker can't always
+    prove it)."""
+    try:
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                          check_vma=False)
+    except TypeError:  # older kwarg name
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                          check_rep=False)
+
+
+class ShardedLearner:
+    """Builds and caches the shard_mapped grower for one configuration."""
+
+    def __init__(self, mode: str, mesh: Mesh, params: GrowParams):
+        assert mode in ("data", "feature", "voting")
+        self.mode = mode
+        self.mesh = mesh
+        self.d = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+        self.params = params._replace(
+            parallel=mode, axis_name="data", num_machines=self.d
+        )
+
+        row_sharded = mode in ("data", "voting")
+        feature_sharded = mode == "feature"
+        d = self.d
+
+        def body(bins, grad, hess, select, fmask, meta, hyper):
+            if feature_sharded:
+                # contiguous per-shard feature ownership
+                # (balanced assignment, feature_parallel_tree_learner.cpp:31-50)
+                f = bins.shape[1]
+                per = -(-f // d)
+                own = (jnp.arange(f) // per) == jax.lax.axis_index("data")
+                fmask = fmask * own.astype(fmask.dtype)
+            return grow_tree(bins, grad, hess, select, fmask, meta, hyper, self.params)
+
+        rowspec = P("data") if row_sharded else P()
+        in_specs = (
+            P("data", None) if row_sharded else P(),  # bins
+            rowspec,  # grad
+            rowspec,  # hess
+            rowspec,  # select
+            P(),  # feature_mask
+            P(),  # meta
+            P(),  # hyper
+        )
+        out_specs = GrowResult(
+            num_splits=P(),
+            leaf_id=P("data") if row_sharded else P(),
+            leaf_value=P(),
+            leaf_cnt=P(),
+            rec_leaf=P(),
+            rec_feat=P(),
+            rec_thr=P(),
+            rec_dbz=P(),
+            rec_gain=P(),
+            rec_lval=P(),
+            rec_rval=P(),
+            rec_lcnt=P(),
+            rec_rcnt=P(),
+            rec_internal_value=P(),
+        )
+        self._fn = jax.jit(
+            _shard_map_compat(body, mesh, in_specs, out_specs)
+        )
+        self._row_sharded = row_sharded
+
+    # ------------------------------------------------------------------
+    def grow(self, bins, grad, hess, select, feature_mask, meta, hyper) -> GrowResult:
+        n = bins.shape[0]
+        pad = (-n) % self.d if self._row_sharded else 0
+        if pad:
+            bins = jnp.pad(bins, ((0, pad), (0, 0)))
+            grad = jnp.pad(grad, (0, pad))
+            hess = jnp.pad(hess, (0, pad))
+            select = jnp.pad(select, (0, pad))  # padded rows: select=0
+        gr = self._fn(bins, grad, hess, select, feature_mask, meta, hyper)
+        if pad:
+            gr = gr._replace(leaf_id=gr.leaf_id[:n])
+        return gr
