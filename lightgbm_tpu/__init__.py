@@ -24,6 +24,14 @@ try:  # sklearn wrappers are optional (sklearn is present in CI images)
 except ImportError:  # pragma: no cover
     pass
 
+try:  # plotting needs matplotlib (graphviz optional for plot_tree)
+    from . import plotting
+    from .plotting import plot_importance, plot_metric, plot_tree, create_tree_digraph
+except ImportError:  # pragma: no cover
+    pass
+
+from . import config, metric, objective
+
 __all__ = [
     "Dataset",
     "Booster",
@@ -37,4 +45,8 @@ __all__ = [
     "log_evaluation",
     "record_evaluation",
     "reset_parameter",
+    "plot_importance",
+    "plot_metric",
+    "plot_tree",
+    "create_tree_digraph",
 ]

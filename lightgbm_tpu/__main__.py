@@ -1,0 +1,8 @@
+"""``python -m lightgbm_tpu task=train config=train.conf`` — the
+counterpart of the ``lightgbm`` binary (src/main.cpp)."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

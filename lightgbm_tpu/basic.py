@@ -248,7 +248,12 @@ class Booster:
             self.train_dataset = train_set
             self.objective = create_objective(self.config)
             self.boosting = create_boosting(self.config.boosting_type)
-            training_metrics = self._make_metrics(binned)
+            # training metrics only when asked (is_provide_training_metric
+            # gate, gbdt.cpp ResetTrainingData); the python engine path
+            # evaluates "training" as a valid set instead
+            training_metrics = (
+                self._make_metrics(binned) if self.config.is_training_metric else []
+            )
             self.boosting.init(self.config, binned, self.objective, training_metrics)
             self._num_datasets = 1
         elif model_file is not None or model_str is not None:

@@ -103,6 +103,26 @@ class GBDT:
                 )
         elif learner_type != "serial":
             Log.fatal("Unknown tree learner type %s", config.tree_learner)
+
+        # Optional host-driven O(N_leaf) grower (ops/fast_grow).  Only wins
+        # when the device is host-local (sub-ms dispatch): over a tunneled
+        # device its ~4 round-trips per split are 10x slower than the
+        # single-program grower, whose lax.switch compaction tiers already
+        # give O(bucket(N_leaf)) histogram work in-program.  Opt in with
+        # LIGHTGBM_TPU_HOST_GROWER=1.
+        import os as _os
+
+        self.fast_grower = None
+        if (
+            self.learner is None
+            and self.num_data >= 65536
+            and _os.environ.get("LIGHTGBM_TPU_HOST_GROWER", "0") == "1"
+        ):
+            from ..ops.fast_grow import FastGrower
+
+            self.fast_grower = FastGrower(
+                train_set.binned, self.meta, self.hyper, self.grow_params
+            )
         k = self.num_tree_per_iteration
         self.scores = jnp.zeros((k, self.num_data), jnp.float32)
         init_score = train_set.metadata.init_score
@@ -232,51 +252,62 @@ class GBDT:
     def train_one_iter(self, gradients=None, hessians=None, is_eval: bool = True) -> bool:
         """One boosting iteration (GBDT::TrainOneIter, gbdt.cpp:381-495).
         Returns True when training should stop."""
+        from ..utils.profiling import timetag
+
         self._boost_from_average()
 
-        if gradients is None or hessians is None:
-            grad, hess = self._get_gradients()
-        else:
-            grad = jnp.asarray(np.asarray(gradients, np.float32).reshape(
-                self.num_tree_per_iteration, -1))
-            hess = jnp.asarray(np.asarray(hessians, np.float32).reshape(
-                self.num_tree_per_iteration, -1))
+        with timetag.phase("boosting"):
+            if gradients is None or hessians is None:
+                grad, hess = self._get_gradients()
+            else:
+                grad = jnp.asarray(np.asarray(gradients, np.float32).reshape(
+                    self.num_tree_per_iteration, -1))
+                hess = jnp.asarray(np.asarray(hessians, np.float32).reshape(
+                    self.num_tree_per_iteration, -1))
 
-        grad, hess = self._adjust_gradients(grad, hess)
-        self._bagging(self.iter)
+        with timetag.phase("bagging"):
+            grad, hess = self._adjust_gradients(grad, hess)
+            self._bagging(self.iter)
 
         should_continue = False
         for k in range(self.num_tree_per_iteration):
             feature_mask = self._feature_mask()
-            if self.learner is not None:
-                gr = self.learner.grow(
-                    self.bins, grad[k], hess[k], self.select, feature_mask,
-                    self.meta, self.hyper,
-                )
-            else:
-                gr = grow_tree(
-                    self.bins,
-                    grad[k],
-                    hess[k],
-                    self.select,
-                    feature_mask,
-                    self.meta,
-                    self.hyper,
-                    self.grow_params,
-                )
+            with timetag.phase("tree"):
+                if self.learner is not None:
+                    gr = self.learner.grow(
+                        self.bins, grad[k], hess[k], self.select, feature_mask,
+                        self.meta, self.hyper,
+                    )
+                elif self.fast_grower is not None:
+                    gr = self.fast_grower.grow(
+                        grad[k], hess[k], self.select, feature_mask
+                    )
+                else:
+                    gr = grow_tree(
+                        self.bins,
+                        grad[k],
+                        hess[k],
+                        self.select,
+                        feature_mask,
+                        self.meta,
+                        self.hyper,
+                        self.grow_params,
+                    )
             num_splits = int(gr.num_splits)
             if num_splits > 0:
                 should_continue = True
                 tree = Tree.from_grow_result(gr, self.train_set)
                 tree.shrinkage(self.shrinkage_rate)
-                # train-score update via the grower's partition (one gather)
-                lv = np.zeros(self.grow_params.num_leaves, np.float32)
-                lv[: tree.num_leaves] = tree.leaf_value[: tree.num_leaves]
-                leaf_vals = jnp.asarray(lv)
-                self.scores = self.scores.at[k].set(
-                    add_leaf_outputs(self.scores[k], gr.leaf_id, leaf_vals)
-                )
-                self._add_tree_to_valid_scores(tree, k)
+                with timetag.phase("train_score"):
+                    # score update via the grower's partition (one gather)
+                    lv = np.zeros(self.grow_params.num_leaves, np.float32)
+                    lv[: tree.num_leaves] = tree.leaf_value[: tree.num_leaves]
+                    leaf_vals = jnp.asarray(lv)
+                    self.scores = self.scores.at[k].set(
+                        add_leaf_outputs(self.scores[k], gr.leaf_id, leaf_vals)
+                    )
+                with timetag.phase("valid_score"):
+                    self._add_tree_to_valid_scores(tree, k)
             else:
                 tree = Tree(2)  # empty tree, kept for alignment
             self.models.append(tree)
@@ -445,6 +476,8 @@ class GBDT:
         """Re-derive the config-dependent training state after a parameter
         reset (ResetConfig path used by callback.reset_parameter)."""
         self.hyper = SplitHyper.from_config(self.config)
+        if self.fast_grower is not None:
+            self.fast_grower.hyper = self.hyper
         self.shrinkage_rate = self.config.learning_rate
         self.is_bagging = (
             self.config.bagging_fraction < 1.0 and self.config.bagging_freq > 0

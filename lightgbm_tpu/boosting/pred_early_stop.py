@@ -1,0 +1,75 @@
+"""Prediction early stopping — counterpart of
+src/boosting/prediction_early_stop.cpp: margin-based early exit across
+trees during row-at-a-time prediction.
+
+On TPU the batched vmapped traversal (ops/predict.py) is usually faster
+than any early exit; this host path exists for API parity and for
+latency-sensitive single-row serving, mirroring the reference's
+round_period/margin_threshold semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import numpy as np
+
+from ..utils.log import Log
+
+
+class PredictionEarlyStopInstance(NamedTuple):
+    """(callback, round_period) — callback(pred_row) -> stop?"""
+
+    callback: Callable[[np.ndarray], bool]
+    round_period: int
+
+
+def create_prediction_early_stop_instance(
+    type_: str, round_period: int = 10, margin_threshold: float = 10.0
+) -> PredictionEarlyStopInstance:
+    """CreatePredictionEarlyStopInstance (prediction_early_stop.cpp:74-89)."""
+    if type_ == "none":
+        return PredictionEarlyStopInstance(lambda pred: False, 1 << 30)
+    if type_ == "binary":
+
+        def cb_binary(pred: np.ndarray) -> bool:
+            if len(pred) != 1:
+                Log.fatal("Binary early stopping needs predictions to be of length one")
+            return 2.0 * abs(float(pred[0])) > margin_threshold
+
+        return PredictionEarlyStopInstance(cb_binary, round_period)
+    if type_ == "multiclass":
+
+        def cb_multiclass(pred: np.ndarray) -> bool:
+            if len(pred) < 2:
+                Log.fatal(
+                    "Multiclass early stopping needs predictions to be of "
+                    "length two or larger"
+                )
+            top2 = np.partition(pred, -2)[-2:]
+            return float(top2[1] - top2[0]) > margin_threshold
+
+        return PredictionEarlyStopInstance(cb_multiclass, round_period)
+    Log.fatal("Unknown early stopping type: %s", type_)
+
+
+def predict_with_early_stop(
+    boosting, data: np.ndarray, early_stop: PredictionEarlyStopInstance
+) -> np.ndarray:
+    """Row-at-a-time raw prediction with the margin exit
+    (GBDT::PredictRaw + early stop, gbdt_prediction.cpp)."""
+    k = boosting.num_tree_per_iteration
+    models = boosting.models
+    n = data.shape[0]
+    out = np.zeros((n, k))
+    for r in range(n):
+        row = data[r: r + 1]
+        pred = np.zeros(k)
+        for i in range(0, len(models), k):
+            for kk in range(k):
+                pred[kk] += float(models[i + kk].predict(row)[0])
+            iter_idx = i // k + 1
+            if iter_idx % early_stop.round_period == 0 and early_stop.callback(pred):
+                break
+        out[r] = pred
+    return out

@@ -1,0 +1,155 @@
+"""CLI application — counterpart of src/application/application.cpp +
+src/main.cpp: ``python -m lightgbm_tpu task=train config=train.conf``
+accepts the reference's key=value argv and .conf files unmodified
+(LoadParameters, application.cpp:48-104).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Dict, List
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import PARAM_ALIASES, Config, canonicalize_params
+from .utils.log import Log
+
+
+def parse_argv(argv: List[str]) -> Dict[str, str]:
+    """key=value argv parsing (LoadParameters, application.cpp:48-61)."""
+    params: Dict[str, str] = {}
+    for arg in argv:
+        if "=" in arg:
+            key, _, value = arg.partition("=")
+            key = key.strip().strip('"').strip("'")
+            value = value.strip().strip('"').strip("'")
+            if key:
+                params[key] = value
+        else:
+            Log.warning("Unknown parameter in command line: %s", arg)
+    return params
+
+
+def parse_config_file(path: str) -> Dict[str, str]:
+    """.conf parsing with '#' comments (application.cpp:66-98)."""
+    params: Dict[str, str] = {}
+    if not os.path.exists(path):
+        Log.warning("Config file %s doesn't exist, will ignore", path)
+        return params
+    with open(path) as f:
+        for line in f:
+            if "#" in line:
+                line = line[: line.index("#")]
+            line = line.strip()
+            if not line:
+                continue
+            if "=" in line:
+                key, _, value = line.partition("=")
+                key = key.strip().strip('"').strip("'")
+                value = value.strip().strip('"').strip("'")
+                if key:
+                    params[key] = value
+            else:
+                Log.warning("Unknown parameter in config file: %s", line)
+    return params
+
+
+def load_all_params(argv: List[str]) -> Dict[str, str]:
+    params = parse_argv(argv)
+    # resolve config/config_file alias before reading the file
+    cfg_path = params.get("config_file") or params.get("config")
+    if cfg_path:
+        file_params = parse_config_file(cfg_path)
+        for key, value in file_params.items():
+            # command line has higher priority (application.cpp:87-89)
+            canon = PARAM_ALIASES.get(key, key)
+            if key not in params and canon not in params and not any(
+                PARAM_ALIASES.get(k, k) == canon for k in params
+            ):
+                params[key] = value
+    params.pop("config", None)
+    params.pop("config_file", None)
+    return params
+
+
+def run_train(config: Config, params: Dict[str, str]) -> None:
+    """InitTrain + Train (application.cpp:188-250)."""
+    if not config.data:
+        Log.fatal("No training data, application quit")
+    train_ds = Dataset(config.data, params=dict(params))
+    booster = Booster(params=dict(params), train_set=train_ds)
+    for i, vpath in enumerate(config.valid_data):
+        name = os.path.basename(vpath)
+        booster.add_valid(train_ds.create_valid(vpath), name)
+    if config.is_save_binary_file:
+        train_ds.save_binary(config.data + ".bin")
+
+    b = booster.boosting
+    num_iters = config.num_iterations
+    Log.info("Started training...")
+    for it in range(num_iters):
+        start = time.time()
+        finished = b.train_one_iter(is_eval=True)
+        Log.info("%f seconds elapsed, finished iteration %d",
+                 time.time() - start, it + 1)
+        if config.snapshot_freq > 0 and (it + 1) % config.snapshot_freq == 0:
+            snap = f"{config.output_model}.snapshot_iter_{it + 1}"
+            b.save_model_to_file(snap)
+            Log.info("Saved snapshot to %s", snap)
+        if finished:
+            Log.info("Early stopping at iteration %d", it + 1)
+            break
+    b.save_model_to_file(config.output_model)
+    Log.info("Finished training, model saved to %s", config.output_model)
+
+
+def run_predict(config: Config, params: Dict[str, str]) -> None:
+    """Predict path (application.cpp:252-260, predictor.hpp)."""
+    if not config.data:
+        Log.fatal("No data for prediction, application quit")
+    if not config.input_model:
+        Log.fatal("No model file for prediction, application quit")
+    booster = Booster(params=dict(params), model_file=config.input_model)
+    preds = booster.predict(
+        config.data,
+        num_iteration=config.num_iteration_predict,
+        raw_score=config.is_predict_raw_score,
+        pred_leaf=config.is_predict_leaf_index,
+    )
+    preds = np.atleast_1d(preds)
+    with open(config.output_result, "w") as f:
+        if preds.ndim == 1:
+            for v in preds:
+                f.write(f"{v:g}\n")
+        else:
+            for row in preds:
+                f.write("\t".join(f"{v:g}" for v in row) + "\n")
+    Log.info("Finished prediction, results saved to %s", config.output_result)
+
+
+def main(argv: List[str] = None) -> int:
+    """Application::Run (application.h:82, main.cpp:4-21)."""
+    if argv is None:
+        argv = sys.argv[1:]
+    try:
+        params = load_all_params(argv)
+        config = Config.from_params(params)
+        if config.task == "train":
+            run_train(config, params)
+        elif config.task in ("predict", "prediction", "test"):
+            run_predict(config, params)
+        elif config.task == "convert_model":
+            Log.fatal("convert_model is not supported on the TPU build")
+        else:
+            Log.fatal("Unknown task type %s", config.task)
+    except Exception as ex:  # main.cpp catches and exits non-zero
+        Log.warning("Met Exceptions: %s", ex)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,321 @@
+"""Host-driven leaf-wise grower with O(N_leaf) histogram work — the
+performance-oriented counterpart of SerialTreeLearner + DataPartition
+(serial_tree_learner.cpp:152-207, data_partition.hpp:94-150).
+
+The jitted while-loop grower (ops/grow.py) is one compiled program but
+pays O(N) masked histogram work per split — every row is scanned for every
+split.  The reference scans only the smaller child's rows
+(ordered index lists).  This grower restores that asymptotic:
+
+- ``order`` is an (N,) row-index vector kept PARTITIONED by leaf (the
+  reference's DataPartition ``indices_``); each leaf owns a contiguous
+  [start, start+cnt) segment.  Splits re-partition one segment with a
+  stable cumsum-rank scatter — O(segment), static shapes.
+- Histograms gather only the split leaf's segment, padded up to a
+  power-of-two bucket size.  XLA compiles one kernel per bucket
+  (~log2(N/4096) variants), so work per split is O(bucket(N_leaf) · F · B)
+  instead of O(N · F · B) — the factor that separates 5.7 s/iter from the
+  reference GPU's per-row rate.
+- Control flow (best-split table argmax, bucket choice) runs on host like
+  the reference's Train loop; per split the device syncs twice (n_left,
+  and the two children's packed best-split records).
+
+Used by the serial path for large N; the shard_map distributed path keeps
+the single-program grower (collectives must stay inside one program).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .grow import GrowResult
+from .histogram import build_histogram
+from .split import FeatureMeta, SplitHyper, best_split_all_features
+
+MIN_BUCKET = 4096
+
+
+def _bucket(cnt: int, n_pad: int) -> int:
+    """Smallest power-of-two bucket >= cnt (floored at MIN_BUCKET)."""
+    s = MIN_BUCKET
+    while s < cnt:
+        s *= 2
+    return min(s, n_pad)
+
+
+# ----------------------------------------------------------------------
+# jitted kernels (static over bucket size S)
+# ----------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnames=("S", "num_bins"))
+def _hist_segment(bins_p, grad_p, hess_p, select_p, order, start, cnt, S, num_bins):
+    """(F, B, 3) histogram of the segment order[start:start+S], masked to
+    the first ``cnt`` entries — DenseBin::ConstructHistogram over the
+    leaf's data indices."""
+    rows = jax.lax.dynamic_slice(order, (start,), (S,))
+    valid = (jnp.arange(S) < cnt).astype(jnp.float32)
+    seg_bins = bins_p[rows]
+    seg_grad = grad_p[rows]
+    seg_hess = hess_p[rows]
+    seg_sel = select_p[rows] * valid
+    return build_histogram(seg_bins, seg_grad, seg_hess, seg_sel, num_bins,
+                           row_block=min(S, 4096))
+
+
+@functools.partial(jax.jit, static_argnames=("S",))
+def _partition_segment(bins_p, order, start, cnt, feat, thr, dbz, zero_bin, is_cat, S):
+    """Stable in-segment partition (DataPartition::Split): left rows keep
+    order before right rows.  Returns (new_order, n_left)."""
+    seg = jax.lax.dynamic_slice(order, (start,), (S,))
+    pos = jnp.arange(S)
+    valid = pos < cnt
+    col = bins_p[seg, feat].astype(jnp.int32)
+    fval = jnp.where(col == zero_bin, dbz, col)
+    gl = jnp.where(is_cat, fval == thr, fval <= thr) & valid
+    gr = valid & ~gl
+    n_left = jnp.sum(gl)
+    lrank = jnp.cumsum(gl) - 1
+    rrank = jnp.cumsum(gr) - 1
+    tgt = jnp.where(gl, lrank, jnp.where(gr, n_left + rrank, pos))
+    new_seg = jnp.zeros_like(seg).at[tgt].set(seg)
+    order = jax.lax.dynamic_update_slice(order, new_seg, (start,))
+    return order, n_left
+
+
+def _pack(res):
+    """SplitResult -> one f32 vector so the host pulls a single buffer.
+    int fields are exact in f32 (< 2^24)."""
+    return jnp.stack([
+        res.gain,
+        res.feature.astype(jnp.float32),
+        res.threshold_bin.astype(jnp.float32),
+        res.default_bin_for_zero.astype(jnp.float32),
+        res.left_sum_g, res.left_sum_h, res.left_cnt,
+    ])
+
+
+@functools.partial(jax.jit, static_argnames=("use_missing",))
+def _best_split_pair(lhist, rhist, lsums, rsums, meta, hyper, feature_mask,
+                     use_missing):
+    """Both children's best splits in one program -> (2, 7) packed."""
+    lres = best_split_all_features(lhist, lsums[0], lsums[1], lsums[2], meta,
+                                   hyper, feature_mask, use_missing)
+    rres = best_split_all_features(rhist, rsums[0], rsums[1], rsums[2], meta,
+                                   hyper, feature_mask, use_missing)
+    return jnp.stack([_pack(lres), _pack(rres)])
+
+
+@functools.partial(jax.jit, static_argnames=("use_missing",))
+def _best_split_root(hist, sums, meta, hyper, feature_mask, use_missing):
+    res = best_split_all_features(hist, sums[0], sums[1], sums[2], meta,
+                                  hyper, feature_mask, use_missing)
+    return _pack(res)
+
+
+@jax.jit
+def _root_stats(grad, hess, select):
+    return jnp.stack([jnp.sum(grad * select), jnp.sum(hess * select),
+                      jnp.sum(select)])
+
+
+@jax.jit
+def _leaf_id_from_segments(order_n, seg_starts, seg_leaves):
+    """leaf_id[row] from contiguous segments: position -> leaf via
+    searchsorted over sorted starts, scattered through the order
+    permutation."""
+    pos = jnp.arange(order_n.shape[0])
+    leaf_at_pos = seg_leaves[jnp.searchsorted(seg_starts, pos, side="right") - 1]
+    return jnp.zeros_like(order_n).at[order_n].set(leaf_at_pos)
+
+
+class FastGrower:
+    """Grows trees with host control flow; reusable across iterations
+    (kernels cached per bucket size)."""
+
+    def __init__(self, bins, meta: FeatureMeta, hyper: SplitHyper, params):
+        n, f = bins.shape
+        self.n = n
+        self.params = params
+        self.meta = meta
+        self.hyper = hyper
+        self.n_pad = 1
+        while self.n_pad < max(n, MIN_BUCKET):
+            self.n_pad *= 2
+        self.bins = jnp.asarray(bins)
+        # one dummy row (index n) absorbs bucket-padding gathers
+        self.bins_p = jnp.concatenate(
+            [self.bins, jnp.zeros((1, f), self.bins.dtype)], axis=0
+        )
+        # order padded by n_pad: a segment's bucket never overruns
+        # (start + bucket(cnt) <= n + n_pad since bucket(cnt) <= n_pad)
+        self._order_init = jnp.concatenate(
+            [jnp.arange(n, dtype=jnp.int32),
+             jnp.full((self.n_pad,), n, jnp.int32)]
+        )
+        self.db = np.asarray(meta.default_bin)
+        self.cat = np.asarray(meta.is_categorical)
+
+    def grow(self, grad, hess, select, feature_mask) -> GrowResult:
+        p = self.params
+        L, B = p.num_leaves, p.num_bins
+        n = self.n
+        um = bool(p.use_missing)
+        grad_p = jnp.concatenate([grad, jnp.zeros((1,), grad.dtype)])
+        hess_p = jnp.concatenate([hess, jnp.zeros((1,), hess.dtype)])
+        select_p = jnp.concatenate([select, jnp.zeros((1,), select.dtype)])
+        order = self._order_init
+
+        # root: full-data histogram (no gather needed)
+        root_hist = build_histogram(self.bins, grad, hess, select, B)
+        stats = np.asarray(_root_stats(grad, hess, select), np.float64)
+        tg, th, tc = stats
+        pool = jnp.zeros((L,) + root_hist.shape, jnp.float32).at[0].set(root_hist)
+        root_packed = np.asarray(
+            _best_split_root(root_hist, jnp.asarray(stats, jnp.float32),
+                             self.meta, self.hyper, feature_mask, um),
+            np.float64,
+        )
+
+        # host-side bookkeeping (the reference's best_split_per_leaf_)
+        starts = np.zeros(L, np.int64)
+        cnts = np.zeros(L, np.int64)
+        depths = np.zeros(L, np.int64)
+        sums = np.zeros((L, 3))
+        leaf_values = np.zeros(L)
+        # cnts[] = SEGMENT sizes (all rows, selected or not — the partition
+        # moves every row like the reference moves every index); the
+        # statistical (selected) counts live in sums[:, 2] / bs["left"][2]
+        cnts[0] = n
+        sums[0] = [tg, th, tc]
+        bs = {
+            "gain": np.full(L, -np.inf),
+            "feat": np.zeros(L, np.int64),
+            "thr": np.zeros(L, np.int64),
+            "dbz": np.zeros(L, np.int64),
+            "left": np.zeros((L, 3)),
+        }
+
+        def store(leaf, packed):
+            bs["gain"][leaf] = packed[0]
+            bs["feat"][leaf] = int(packed[1])
+            bs["thr"][leaf] = int(packed[2])
+            bs["dbz"][leaf] = int(packed[3])
+            bs["left"][leaf] = packed[4:7]
+
+        store(0, root_packed)
+
+        rec = {k: np.zeros(max(L - 1, 1), np.int64)
+               for k in ("leaf", "feat", "thr", "dbz")}
+        recf = {k: np.zeros(max(L - 1, 1)) for k in
+                ("gain", "lval", "rval", "lcnt", "rcnt", "ival")}
+        num_splits = 0
+        l1 = float(self.hyper.lambda_l1)
+        l2 = float(self.hyper.lambda_l2)
+
+        def out(sg, sh):
+            reg = max(abs(sg) - l1, 0.0)
+            return -np.sign(sg) * reg / (sh + l2) if (sh + l2) != 0 else 0.0
+
+        # segment bookkeeping note: cnts[] counts SELECTED+unselected rows
+        # of the segment (the partition moves every row; histograms mask by
+        # select), exactly like the reference partitions all indices.
+        for s in range(L - 1):
+            bl = int(np.argmax(bs["gain"]))
+            if not (bs["gain"][bl] > 0.0):
+                break
+            feat = int(bs["feat"][bl])
+            thr = int(bs["thr"][bl])
+            dbz = int(bs["dbz"][bl])
+            start, cnt = int(starts[bl]), int(cnts[bl])
+            S = _bucket(cnt, self.n_pad)
+            order, n_left_dev = _partition_segment(
+                self.bins_p, order, jnp.int32(start), jnp.int32(cnt),
+                jnp.int32(feat), jnp.int32(thr), jnp.int32(dbz),
+                jnp.int32(self.db[feat]), jnp.bool_(self.cat[feat]), S,
+            )
+            n_left = int(n_left_dev)
+
+            right_leaf = s + 1
+            left = bs["left"][bl].copy()
+            total = sums[bl]
+            right = total - left
+            lval, rval = out(left[0], left[1]), out(right[0], right[1])
+
+            rec["leaf"][s], rec["feat"][s] = bl, feat
+            rec["thr"][s], rec["dbz"][s] = thr, dbz
+            recf["gain"][s] = bs["gain"][bl]
+            recf["lval"][s], recf["rval"][s] = lval, rval
+            recf["lcnt"][s], recf["rcnt"][s] = left[2], right[2]
+            recf["ival"][s] = leaf_values[bl]
+
+            # segment bookkeeping
+            starts[right_leaf] = start + n_left
+            cnts[right_leaf] = cnt - n_left
+            cnts[bl] = n_left
+            sums[bl], sums[right_leaf] = left, right
+            leaf_values[bl], leaf_values[right_leaf] = lval, rval
+            depths[bl] += 1
+            depths[right_leaf] = depths[bl]
+
+            # smaller child direct, larger by subtraction
+            left_is_smaller = n_left < cnt - n_left
+            sm = bl if left_is_smaller else right_leaf
+            S_sm = _bucket(int(cnts[sm]), self.n_pad)
+            sm_hist = _hist_segment(
+                self.bins_p, grad_p, hess_p, select_p, order,
+                jnp.int32(int(starts[sm])), jnp.int32(int(cnts[sm])), S_sm, B,
+            )
+            lg_hist = pool[bl] - sm_hist
+            if left_is_smaller:
+                lhist, rhist = sm_hist, lg_hist
+            else:
+                lhist, rhist = lg_hist, sm_hist
+            pool = pool.at[bl].set(lhist).at[right_leaf].set(rhist)
+
+            depth_ok = p.max_depth <= 0 or depths[bl] < p.max_depth
+            if depth_ok:
+                packed = np.asarray(
+                    _best_split_pair(
+                        lhist, rhist,
+                        jnp.asarray(left, jnp.float32),
+                        jnp.asarray(right, jnp.float32),
+                        self.meta, self.hyper, feature_mask, um,
+                    ),
+                    np.float64,
+                )
+                store(bl, packed[0])
+                store(right_leaf, packed[1])
+            else:
+                bs["gain"][bl] = -np.inf
+                bs["gain"][right_leaf] = -np.inf
+            num_splits += 1
+
+        # leaf_id from the final segment layout
+        nl = num_splits + 1
+        seg_order = np.argsort(starts[:nl], kind="stable")
+        leaf_id = _leaf_id_from_segments(
+            order[:n],
+            jnp.asarray(starts[:nl][seg_order].astype(np.int32)),
+            jnp.asarray(seg_order.astype(np.int32)),
+        )
+
+        m = max(L - 1, 1)
+        return GrowResult(
+            num_splits=jnp.int32(num_splits),
+            leaf_id=leaf_id,
+            leaf_value=jnp.asarray(leaf_values.astype(np.float32)),
+            leaf_cnt=jnp.asarray(sums[:L, 2].astype(np.float32)),
+            rec_leaf=jnp.asarray(rec["leaf"][:m].astype(np.int32)),
+            rec_feat=jnp.asarray(rec["feat"][:m].astype(np.int32)),
+            rec_thr=jnp.asarray(rec["thr"][:m].astype(np.int32)),
+            rec_dbz=jnp.asarray(rec["dbz"][:m].astype(np.int32)),
+            rec_gain=jnp.asarray(recf["gain"][:m].astype(np.float32)),
+            rec_lval=jnp.asarray(recf["lval"][:m].astype(np.float32)),
+            rec_rval=jnp.asarray(recf["rval"][:m].astype(np.float32)),
+            rec_lcnt=jnp.asarray(recf["lcnt"][:m].astype(np.float32)),
+            rec_rcnt=jnp.asarray(recf["rcnt"][:m].astype(np.float32)),
+            rec_internal_value=jnp.asarray(recf["ival"][:m].astype(np.float32)),
+        )

@@ -69,6 +69,36 @@ class GrowParams(NamedTuple):
     axis_name: str = ""  # mesh axis name for the collectives
     top_k: int = 20  # voting: top-k voted features (config top_k)
     num_machines: int = 1  # voting: local-constraint scaling divisor
+    compact: bool = True  # tiered leaf-row compaction (see _tiers)
+
+
+# Smallest compaction tier.  Below ~4x this, the masked full-scan is
+# cheaper than the gather choreography.
+TIER_MIN = 8192
+
+
+def _tiers(n: int, include_full: bool = False):
+    """Static power-of-two buffer sizes N/2, N/4, ... >= TIER_MIN.
+
+    The smaller child of any split has at most half its parent's rows, so
+    a leaf with cnt rows fits the smallest tier >= cnt; `lax.switch` picks
+    the branch at runtime.  This is the in-program counterpart of the
+    reference's per-leaf index lists (DataPartition) — O(bucket(N_leaf))
+    histogram work per split instead of O(N), with every branch statically
+    shaped so the whole tree still grows inside one XLA program.
+
+    ``include_full`` adds a full-size bucket for the row-sharded modes:
+    there "smaller" is decided by GLOBAL counts, and the globally-smaller
+    child may still own every row of one shard."""
+    npow = 1
+    while npow < n:
+        npow *= 2
+    out = [npow] if include_full else []
+    s = npow // 2
+    while s >= TIER_MIN:
+        out.append(s)
+        s //= 2
+    return out
 
 
 class GrowResult(NamedTuple):
@@ -108,6 +138,7 @@ class _State(NamedTuple):
     leaf_value: jnp.ndarray  # (L,)
     leaf_cnt: jnp.ndarray  # (L,)
     leaf_depth: jnp.ndarray  # (L,)
+    leaf_rows: jnp.ndarray  # (L,) int32 LOCAL row count (tier choice)
     # split records
     rec_leaf: jnp.ndarray
     rec_feat: jnp.ndarray
@@ -156,14 +187,78 @@ def grow_tree(
     B = params.num_bins
     mode = params.parallel
     ax = params.axis_name
+    tiers = (
+        _tiers(n, include_full=params.parallel in ("data", "voting"))
+        if params.compact
+        else []
+    )
 
-    def hist_of(sel):
-        h = build_histogram(bins, grad, hess, sel, B, params.row_block)
+    if tiers:
+        # Random row access on TPU is latency-bound (~tens of M rows/s),
+        # so the compaction gather must touch each row ONCE: bins are
+        # byte-packed into int32 words and concatenated with the bitcast
+        # grad/hess/select columns — one (S, W) gather per histogram
+        # instead of four.  (The TPU analogue of the reference's 4-bit
+        # packed Dense4bitsBin, dense_nbits_bin.hpp, generalized to the
+        # gather path.)
+        per = 4 if bins.dtype == jnp.uint8 else 2
+        bits = 8 if per == 4 else 16
+        lanes = -(-f // per)
+        pad_f = lanes * per - f
+        bb = jnp.pad(bins, ((0, 0), (0, pad_f))).astype(jnp.int32)
+        bb = bb.reshape(n, lanes, per)
+        shifts = (jnp.arange(per) * bits).astype(jnp.int32)
+        packed = jnp.sum(bb << shifts[None, None, :], axis=2, dtype=jnp.int32)
+        comb = jnp.concatenate(
+            [
+                packed,
+                jax.lax.bitcast_convert_type(grad, jnp.int32)[:, None],
+                jax.lax.bitcast_convert_type(hess, jnp.int32)[:, None],
+                jax.lax.bitcast_convert_type(select, jnp.int32)[:, None],
+            ],
+            axis=1,
+        )
+        # dummy row n absorbs the compaction buffers' padding gathers
+        comb_p = jnp.concatenate([comb, jnp.zeros((1, lanes + 3), jnp.int32)], 0)
+        unpack_mask = jnp.int32((1 << bits) - 1)
+
+    def _reduce_hist(h):
         if mode == "data":
             h = jax.lax.psum(h, ax)
         # voting keeps LOCAL histograms in the pool; serial/feature are
         # already global (feature mode replicates rows)
         return h
+
+    def hist_full(sel):
+        return _reduce_hist(build_histogram(bins, grad, hess, sel, B, params.row_block))
+
+    def hist_leaf(leaf_mask, row_cnt):
+        """Histogram of one leaf's rows.  With tiers: compact the leaf's
+        rows into the smallest static power-of-two buffer that fits
+        (lax.switch picks the branch), so work is O(bucket(N_leaf) * F * B)
+        instead of O(N * F * B) — the in-program DataPartition."""
+        if not tiers:
+            return hist_full(select * leaf_mask.astype(select.dtype))
+
+        def make_branch(S):
+            def br(mask):
+                rows = jnp.nonzero(mask, size=S, fill_value=n)[0]
+                cm = comb_p[rows]  # (S, lanes+3): the single gather
+                words = cm[:, :lanes, None] >> shifts[None, None, :]
+                sbins = (words & unpack_mask).reshape(S, lanes * per)[:, :f]
+                sgrad = jax.lax.bitcast_convert_type(cm[:, lanes], jnp.float32)
+                shess = jax.lax.bitcast_convert_type(cm[:, lanes + 1], jnp.float32)
+                ssel = jax.lax.bitcast_convert_type(cm[:, lanes + 2], jnp.float32)
+                return build_histogram(
+                    sbins, sgrad, shess, ssel, B, min(S, params.row_block)
+                )
+            return br
+
+        tiers_arr = jnp.asarray(tiers)  # descending sizes
+        fits = (tiers_arr >= row_cnt).astype(jnp.int32)
+        idx = jnp.clip(jnp.sum(fits) - 1, 0, len(tiers) - 1)
+        h = jax.lax.switch(idx, [make_branch(S) for S in tiers], leaf_mask)
+        return _reduce_hist(h)
 
     def global_sums(tg, th, tc):
         if mode in ("data", "voting"):
@@ -226,7 +321,7 @@ def grow_tree(
     th = jnp.sum(hess * select)
     tc = jnp.sum(select)
     tg, th, tc = global_sums(tg, th, tc)
-    root_hist = hist_of(select)
+    root_hist = hist_full(select)
     root_sums = jnp.stack([tg, th, tc])
     root_res = find_best(root_hist, root_sums, jnp.array(True))
 
@@ -248,6 +343,7 @@ def grow_tree(
         leaf_value=zf,
         leaf_cnt=zf.at[0].set(tc),
         leaf_depth=zi,
+        leaf_rows=zi.at[0].set(n),
         rec_leaf=zri, rec_feat=zri, rec_thr=zri, rec_dbz=zri,
         rec_gain=zr, rec_lval=zr, rec_rval=zr, rec_lcnt=zr, rec_rcnt=zr,
         rec_internal_value=zr,
@@ -290,10 +386,23 @@ def grow_tree(
         in_leaf = st.leaf_id == bl
         leaf_id = jnp.where(in_leaf & ~goes_left, right_leaf, st.leaf_id)
 
-        # ---- histograms: smaller child direct, larger by subtraction
-        is_left_smaller = lc < rc
+        # ---- histograms: smaller child direct, larger by subtraction.
+        # "smaller" is by row count (not selected count) so the compaction
+        # tier always fits the computed child.  Row-sharded modes must
+        # agree GLOBALLY on which child is computed — the psum'd histogram
+        # would otherwise mix one shard's left rows with another's right.
+        n_rows_left = jnp.sum((in_leaf & goes_left).astype(jnp.int32))
+        n_rows_right = st.leaf_rows[bl] - n_rows_left
+        if mode in ("data", "voting"):
+            g_left = jax.lax.psum(n_rows_left, ax)
+            g_right = jax.lax.psum(n_rows_right, ax)
+        else:
+            g_left, g_right = n_rows_left, n_rows_right
+        is_left_smaller = g_left < g_right
         smaller_id = jnp.where(is_left_smaller, bl, right_leaf)
-        smaller_hist = hist_of(select * (leaf_id == smaller_id))
+        # tier choice uses the LOCAL row count of the chosen child
+        smaller_rows = jnp.where(is_left_smaller, n_rows_left, n_rows_right)
+        smaller_hist = hist_leaf(leaf_id == smaller_id, smaller_rows)
         larger_hist = st.pool[bl] - smaller_hist
         left_hist = jnp.where(is_left_smaller, smaller_hist, larger_hist)
         right_hist = jnp.where(is_left_smaller, larger_hist, smaller_hist)
@@ -317,6 +426,7 @@ def grow_tree(
             leaf_value=st.leaf_value.at[bl].set(lval).at[right_leaf].set(rval),
             leaf_cnt=st.leaf_cnt.at[bl].set(lc).at[right_leaf].set(rc),
             leaf_depth=st.leaf_depth.at[bl].set(child_depth).at[right_leaf].set(child_depth),
+            leaf_rows=st.leaf_rows.at[bl].set(n_rows_left).at[right_leaf].set(n_rows_right),
             rec_leaf=st.rec_leaf.at[s].set(bl),
             rec_feat=st.rec_feat.at[s].set(feat),
             rec_thr=st.rec_thr.at[s].set(thr),

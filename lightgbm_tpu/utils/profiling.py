@@ -1,0 +1,82 @@
+"""Tracing/profiling — counterpart of the reference's compile-time TIMETAG
+phase timers (serial_tree_learner.cpp:10-37, gbdt.cpp:22-63) plus the
+per-iteration wall-clock log (application.cpp:233-236).
+
+TPU-first: phases are ``jax.named_scope`` annotations (visible in XLA/
+jax.profiler traces) wrapped in host-side accumulating timers.  Enable
+with LIGHTGBM_TPU_TIMETAG=1 or ``timetag.enable()``; dumped at exit like
+the reference's destructor prints.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import os
+import time
+from collections import defaultdict
+from typing import Dict, Iterator
+
+import jax
+
+from .log import Log
+
+
+class PhaseTimers:
+    """Accumulating named phase timers (the TIMETAG duration maps)."""
+
+    def __init__(self):
+        self.enabled = bool(int(os.environ.get("LIGHTGBM_TPU_TIMETAG", "0")))
+        self.totals: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+        self._dump_registered = False
+
+    def enable(self) -> None:
+        self.enabled = True
+        if not self._dump_registered:
+            atexit.register(self.dump)
+            self._dump_registered = True
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        """Time a phase; also emits a jax.named_scope so device traces
+        (jax.profiler.trace) carry the same phase names."""
+        if not self.enabled:
+            with jax.named_scope(name):
+                yield
+            return
+        start = time.perf_counter()
+        with jax.named_scope(name):
+            yield
+        self.totals[name] += time.perf_counter() - start
+        self.counts[name] += 1
+
+    def dump(self) -> None:
+        """TIMETAG destructor-style dump (serial_tree_learner.cpp:12-24)."""
+        if not self.totals:
+            return
+        for name in sorted(self.totals):
+            Log.info(
+                "%s costs: %f (n=%d)", name, self.totals[name], self.counts[name]
+            )
+
+    def reset(self) -> None:
+        self.totals.clear()
+        self.counts.clear()
+
+
+timetag = PhaseTimers()
+if timetag.enabled:
+    atexit.register(timetag.dump)
+    timetag._dump_registered = True
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str) -> Iterator[None]:
+    """Device-level profiler trace (the deep-dive tool the reference never
+    had): view with TensorBoard / xprof."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

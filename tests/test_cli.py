@@ -1,0 +1,66 @@
+"""CLI tests — the reference's examples/*/train.conf + predict.conf must
+run unmodified (SURVEY §7.10; modeled on tests/cpp_test/test.py which
+trains from two configs and compares prediction files).
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+EXAMPLES = "/root/reference/examples"
+ENV = dict(
+    os.environ,
+    JAX_PLATFORMS="cpu",
+    PYTHONPATH="/root/repo" + os.pathsep + os.environ.get("PYTHONPATH", ""),
+)
+
+
+def _run_cli(args, cwd):
+    return subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu", *args],
+        cwd=cwd, env=ENV, capture_output=True, text=True, timeout=900,
+    )
+
+
+@pytest.fixture(scope="module")
+def regression_dir(tmp_path_factory):
+    """Copy of examples/regression (the originals are read-only)."""
+    dst = tmp_path_factory.mktemp("regression_example")
+    for name in ("train.conf", "predict.conf", "regression.train", "regression.test"):
+        shutil.copy(f"{EXAMPLES}/regression/{name}", dst)
+    return str(dst)
+
+
+def test_reference_train_conf_runs_unmodified(regression_dir):
+    r = _run_cli(["config=train.conf", "num_trees=5"], regression_dir)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert os.path.exists(os.path.join(regression_dir, "LightGBM_model.txt"))
+
+
+def test_reference_predict_conf_runs_unmodified(regression_dir):
+    # depends on the model from the train test; rerun train if missing
+    if not os.path.exists(os.path.join(regression_dir, "LightGBM_model.txt")):
+        _run_cli(["config=train.conf", "num_trees=5"], regression_dir)
+    r = _run_cli(["config=predict.conf"], regression_dir)
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = os.path.join(regression_dir, "LightGBM_predict_result.txt")
+    assert os.path.exists(out)
+    preds = np.loadtxt(out)
+    assert preds.shape[0] == 500  # regression.test rows
+    assert np.all(np.isfinite(preds))
+
+
+def test_cli_param_priority(regression_dir):
+    """Command line overrides the config file (application.cpp:87-89)."""
+    r = _run_cli(
+        ["config=train.conf", "num_trees=2", "output_model=cli_model.txt"],
+        regression_dir,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    model = open(os.path.join(regression_dir, "cli_model.txt")).read()
+    # 2 iterations + boost_from_average init tree
+    assert model.count("Tree=") == 3

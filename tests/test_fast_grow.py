@@ -1,0 +1,131 @@
+"""FastGrower (host-driven O(N_leaf) grower) must reproduce the jitted
+while-loop grower's tree exactly — both implement the identical
+SerialTreeLearner algorithm.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.objective import create_objective
+from lightgbm_tpu.ops.fast_grow import FastGrower
+from lightgbm_tpu.ops.grow import GrowParams, grow_tree
+from lightgbm_tpu.ops.split import FeatureMeta, SplitHyper
+
+
+@pytest.fixture(scope="module", params=["binary", "regression"])
+def problem(request):
+    rng = np.random.RandomState(3)
+    n, f = 5000, 10
+    x = rng.randn(n, f)
+    x[:, 3] = np.round(x[:, 3])  # ties / default-bin traffic
+    if request.param == "binary":
+        y = (x[:, 0] + 0.5 * x[:, 1] ** 2 > 0.3).astype(np.float32)
+    else:
+        y = (x[:, 0] - 2 * x[:, 2] + 0.1 * rng.randn(n)).astype(np.float32)
+    cfg = Config.from_params(
+        {"objective": request.param, "num_leaves": 31, "verbose": -1}
+    )
+    ds = BinnedDataset.from_raw(x, cfg, label=y)
+    obj = create_objective(cfg)
+    obj.init(ds.metadata, ds.num_data)
+    grad, hess = obj.get_gradients(jnp.zeros((n,), jnp.float32))
+    return {
+        "ds": ds,
+        "grad": grad,
+        "hess": hess,
+        "meta": FeatureMeta.from_dataset(ds),
+        "hyper": SplitHyper.from_config(cfg),
+        "params": GrowParams(num_leaves=31, num_bins=ds.max_num_bin),
+    }
+
+
+def test_fast_grower_matches_jitted(problem):
+    p = problem
+    n = p["ds"].num_data
+    select = jnp.ones((n,), jnp.float32)
+    fmask = jnp.ones((p["ds"].num_features,), jnp.float32)
+    bins = jnp.asarray(p["ds"].binned)
+
+    ref = grow_tree(bins, p["grad"], p["hess"], select, fmask,
+                    p["meta"], p["hyper"], p["params"])
+    fg = FastGrower(p["ds"].binned, p["meta"], p["hyper"], p["params"])
+    got = fg.grow(p["grad"], p["hess"], select, fmask)
+
+    s = int(ref.num_splits)
+    assert int(got.num_splits) == s
+    np.testing.assert_array_equal(np.asarray(got.rec_feat[:s]),
+                                  np.asarray(ref.rec_feat[:s]))
+    np.testing.assert_array_equal(np.asarray(got.rec_thr[:s]),
+                                  np.asarray(ref.rec_thr[:s]))
+    np.testing.assert_array_equal(np.asarray(got.rec_leaf[:s]),
+                                  np.asarray(ref.rec_leaf[:s]))
+    np.testing.assert_array_equal(np.asarray(got.rec_dbz[:s]),
+                                  np.asarray(ref.rec_dbz[:s]))
+    np.testing.assert_allclose(np.asarray(got.leaf_value),
+                               np.asarray(ref.leaf_value), atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(got.leaf_id),
+                                  np.asarray(ref.leaf_id))
+    np.testing.assert_allclose(np.asarray(got.leaf_cnt),
+                               np.asarray(ref.leaf_cnt), atol=0.5)
+
+
+def test_fast_grower_with_bagging_mask(problem):
+    """Out-of-bag rows must still be routed to leaves (leaf_id covers all
+    rows) while histograms see only selected rows."""
+    p = problem
+    n = p["ds"].num_data
+    rng = np.random.RandomState(0)
+    select_np = (rng.rand(n) < 0.7).astype(np.float32)
+    select = jnp.asarray(select_np)
+    fmask = jnp.ones((p["ds"].num_features,), jnp.float32)
+    bins = jnp.asarray(p["ds"].binned)
+
+    ref = grow_tree(bins, p["grad"], p["hess"], select, fmask,
+                    p["meta"], p["hyper"], p["params"])
+    fg = FastGrower(p["ds"].binned, p["meta"], p["hyper"], p["params"])
+    got = fg.grow(p["grad"], p["hess"], select, fmask)
+
+    s = int(ref.num_splits)
+    assert int(got.num_splits) == s
+    np.testing.assert_array_equal(np.asarray(got.rec_feat[:s]),
+                                  np.asarray(ref.rec_feat[:s]))
+    np.testing.assert_array_equal(np.asarray(got.leaf_id),
+                                  np.asarray(ref.leaf_id))
+
+
+def test_compaction_tiers_match_masked_path():
+    """At N large enough for lax.switch compaction tiers, the grower must
+    match the masked O(N) path exactly (compact=False)."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.grow import _tiers
+
+    rng = np.random.RandomState(7)
+    n, f = 40000, 6
+    assert _tiers(n), "test size must activate tiers"
+    x = rng.randn(n, f)
+    y = (x[:, 0] - 0.8 * x[:, 2] + 0.2 * rng.randn(n) > 0).astype(np.float32)
+    cfg = Config.from_params({"objective": "binary", "num_leaves": 31,
+                              "verbose": -1})
+    ds = BinnedDataset.from_raw(x, cfg, label=y)
+    obj = create_objective(cfg)
+    obj.init(ds.metadata, ds.num_data)
+    grad, hess = obj.get_gradients(jnp.zeros((n,), jnp.float32))
+    meta = FeatureMeta.from_dataset(ds)
+    hyper = SplitHyper.from_config(cfg)
+    select = jnp.ones((n,), jnp.float32)
+    fmask = jnp.ones((ds.num_features,), jnp.float32)
+    bins = jnp.asarray(ds.binned)
+
+    params_c = GrowParams(num_leaves=31, num_bins=ds.max_num_bin, compact=True)
+    params_m = GrowParams(num_leaves=31, num_bins=ds.max_num_bin, compact=False)
+    a = grow_tree(bins, grad, hess, select, fmask, meta, hyper, params_c)
+    b = grow_tree(bins, grad, hess, select, fmask, meta, hyper, params_m)
+    s = int(b.num_splits)
+    assert int(a.num_splits) == s
+    np.testing.assert_array_equal(np.asarray(a.rec_feat[:s]), np.asarray(b.rec_feat[:s]))
+    np.testing.assert_array_equal(np.asarray(a.rec_thr[:s]), np.asarray(b.rec_thr[:s]))
+    np.testing.assert_array_equal(np.asarray(a.leaf_id), np.asarray(b.leaf_id))
+    np.testing.assert_allclose(np.asarray(a.leaf_value), np.asarray(b.leaf_value), atol=2e-4)
