@@ -1,0 +1,179 @@
+"""Pallas TPU histogram kernel — the device counterpart of the
+reference's GPU histogram kernels (src/treelearner/ocl/histogram256.cl:345
+per-workgroup sub-histograms + in-kernel reduction; host driver
+src/treelearner/gpu_tree_learner.cpp:123-191).
+
+Why not the XLA one-hot matmul (ops/histogram.py)?  XLA materializes the
+(rows, F*B) one-hot operand through HBM — ~7 KB of traffic per row — which
+measures at ~0.21 us/row on v5e.  Here the one-hot tile is built in VMEM,
+fed straight to the MXU, and never touches HBM: the kernel streams only
+the packed bin words + values (~44 B/row) and accumulates the (F*B, 4)
+histogram in a VMEM scratch across sequential grid steps.
+
+Input layout: one (C, S) int32 matrix `P` whose rows are
+    [0..W)   : packed bin words (`per` bins of `bits` bits each per word)
+    W        : grad  (f32 bitcast)
+    W+1      : hess  (f32 bitcast)
+    W+2      : select(f32 bitcast; 0/1 bagging x leaf mask)
+(extra rows beyond W+3, e.g. a row-id payload, are ignored).  This is the
+partitioned-data layout of ops/pgrow.py: a leaf's rows are a contiguous
+column range, so the kernel only needs a [lo, hi) column mask — no gather.
+
+Output: (F, B, 3) f32 of (sum_grad, sum_hess, count) per (feature, bin).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Columns (rows of data) per grid step.  The one-hot chunk is
+# (FCHUNK*B, BLK) f32; BLK=1024 with FCHUNK*B<=512 keeps it ~2 MB.
+BLK = 1024
+
+
+def _hist_kernel(lohi_ref, p_ref, out_ref, acc_ref, *, nf, nb, w_words, per, bits, fchunk):
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:, :] = jnp.zeros_like(acc_ref)
+
+    pos = jax.lax.broadcasted_iota(jnp.int32, (1, BLK), 1) + j * BLK
+    valid = ((pos >= lohi_ref[0]) & (pos < lohi_ref[1])).astype(jnp.float32)
+    g = pltpu.bitcast(p_ref[w_words : w_words + 1, :], jnp.float32)
+    h = pltpu.bitcast(p_ref[w_words + 1 : w_words + 2, :], jnp.float32)
+    sel = pltpu.bitcast(p_ref[w_words + 2 : w_words + 3, :], jnp.float32) * valid
+    gs = g * sel
+    hs = h * sel
+
+    # The MXU's fast path is bf16xbf16->f32, but a bf16-rounded gradient
+    # loses ~2^-8 relative accuracy per element (the reference's GPU kernel
+    # keeps f32 accumulators for the same reason, histogram256.cl:345).
+    # Because the dot's N dimension pads to 128 lanes regardless, extra
+    # value rows are FREE: send each value as THREE bf16 terms
+    # (x = hi + mid + lo, covering ~24 mantissa bits = f32 fidelity) and
+    # re-sum the three output columns outside — f32 accuracy at bf16 speed.
+    def split3(x):
+        x_hi = x.astype(jnp.bfloat16)
+        r1 = x - x_hi.astype(jnp.float32)
+        x_mid = r1.astype(jnp.bfloat16)
+        x_lo = (r1 - x_mid.astype(jnp.float32)).astype(jnp.bfloat16)
+        return x_hi, x_mid, x_lo
+
+    g3 = split3(gs)
+    h3 = split3(hs)
+    vals = jnp.concatenate(
+        list(g3) + list(h3) + [sel.astype(jnp.bfloat16)], axis=0
+    )  # (7, BLK) bf16
+
+    mask_v = (1 << bits) - 1
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (nb, BLK), 0)
+    for c0 in range(0, nf, fchunk):
+        c1 = min(c0 + fchunk, nf)
+        chunks = []
+        for f in range(c0, c1):
+            w, p = divmod(f, per)
+            byte = (p_ref[w : w + 1, :] >> (p * bits)) & mask_v  # (1, BLK)
+            chunks.append((byte == iota_b).astype(jnp.bfloat16))  # (nb, BLK)
+        oh = jnp.concatenate(chunks, axis=0)  # ((c1-c0)*nb, BLK)
+        acc_ref[c0 * nb : c1 * nb, :] += jax.lax.dot_general(
+            oh,
+            vals,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == pl.num_programs(0) - 1)
+    def _flush():
+        out_ref[:, :] = acc_ref[:, :]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_features", "num_bins", "per", "bits")
+)
+def hist_segment(
+    p: jnp.ndarray,
+    lo: jnp.ndarray,
+    hi: jnp.ndarray,
+    num_features: int,
+    num_bins: int,
+    per: int = 4,
+    bits: int = 8,
+) -> jnp.ndarray:
+    """(F, B, 3) histogram of columns [lo, hi) of the packed matrix ``p``.
+
+    p : (C, S) int32, S a multiple of BLK — see module docstring.
+    lo, hi : int32 scalars — the valid column range (the leaf's segment,
+      relative to this slice).  Columns outside contribute zero.
+    """
+    c, s = p.shape
+    assert s % BLK == 0, f"segment length {s} not a multiple of {BLK}"
+    w_words = -(-num_features // per)
+    fb = num_features * num_bins
+    # chunk features so the one-hot tile stays ~<=2MB and row count is a
+    # multiple of 128 where possible
+    fchunk = max(1, min(num_features, 512 // num_bins))
+
+    lohi = jnp.stack([lo.astype(jnp.int32), hi.astype(jnp.int32)])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(s // BLK,),
+        in_specs=[
+            pl.BlockSpec((c, BLK), lambda j, lohi: (0, j), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (fb, 7), lambda j, lohi: (0, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[pltpu.VMEM((fb, 7), jnp.float32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _hist_kernel,
+            nf=num_features,
+            nb=num_bins,
+            w_words=w_words,
+            per=per,
+            bits=bits,
+            fchunk=fchunk,
+        ),
+        out_shape=jax.ShapeDtypeStruct((fb, 7), jnp.float32),
+        grid_spec=grid_spec,
+    )(lohi, p)
+    # re-sum the 3-term splits: (sum_g, sum_h, count)
+    hist = jnp.stack(
+        [
+            out[:, 0] + (out[:, 1] + out[:, 2]),
+            out[:, 3] + (out[:, 4] + out[:, 5]),
+            out[:, 6],
+        ],
+        axis=1,
+    )
+    return hist.reshape(num_features, num_bins, 3)
+
+
+def pack_columns(
+    bins, grad, hess, select, row_id=None, per: int = 4, bits: int = 8
+):
+    """Build the (C, N) int32 packed matrix from (N, F) bins + value
+    vectors.  Rows: W bin words, grad, hess, select[, row_id]."""
+    n, f = bins.shape
+    w = -(-f // per)
+    pad_f = w * per - f
+    bb = jnp.pad(bins.astype(jnp.int32), ((0, 0), (0, pad_f)))
+    bb = bb.reshape(n, w, per)
+    shifts = (jnp.arange(per) * bits).astype(jnp.int32)
+    words = jnp.sum(bb << shifts[None, None, :], axis=2, dtype=jnp.int32)  # (N, W)
+    rows = [
+        words.T,
+        jax.lax.bitcast_convert_type(grad.astype(jnp.float32), jnp.int32)[None, :],
+        jax.lax.bitcast_convert_type(hess.astype(jnp.float32), jnp.int32)[None, :],
+        jax.lax.bitcast_convert_type(select.astype(jnp.float32), jnp.int32)[None, :],
+    ]
+    if row_id is not None:
+        rows.append(row_id.astype(jnp.int32)[None, :])
+    return jnp.concatenate(rows, axis=0)
