@@ -1,0 +1,604 @@
+"""Dynamic-segment Pallas kernels for the partitioned tree grower.
+
+TPU-native counterpart of the reference's histogram kernels and data
+partition (src/treelearner/ocl/histogram256.cl:345 per-workgroup
+sub-histograms + reduction, host driver gpu_tree_learner.cpp:123-191;
+src/treelearner/data_partition.hpp:94-150 ``Split``).
+
+The training matrix ``P`` is one (C, N) int32 array whose rows are
+
+    0..W-1 : packed bin words, 4 uint8 bins per int32 (W = ceil(F/4))
+    W + 0  : grad   (f32 bitcast)
+    W + 1  : hess   (f32 bitcast)
+    W + 2  : select (f32 bitcast; 0/1 bagging mask)
+    W + 3.. : driver-owned channels (scores, label, weight, row id) that
+             the kernels never touch but that travel with every row.
+
+Rows are kept PHYSICALLY PARTITIONED by leaf: each leaf owns a
+contiguous column range [start, start+cnt).  That gives the reference's
+DataPartition asymptotics (O(N_leaf) per histogram / split, not O(N))
+without any gather — TPU gathers measure ~20 Mrow/s while streaming
+DMA + MXU runs at GB/s.
+
+All three kernels run as ONE grid step with an internal dynamic-length
+``fori_loop`` over BLK-column chunks, double-buffered HBM->VMEM DMA, and
+write in place via ``input_output_aliases`` (measured ~3 us/call inside
+a jitted while_loop).  DMA windows must be 128-lane aligned, so every
+stream runs on BLK-aligned windows with the segment's unaligned head
+phase absorbed by a carry buffer (preloaded with the existing head
+block) and the tail merged read-modify-write.
+
+Why matmuls everywhere: Mosaic has no vector scatter/gather and no
+cumsum, but the MXU is nearly free next to HBM bandwidth.  So
+- cumsum(goes_left) = one dot with a triangular ones matrix,
+- the in-block stable compaction is a one-hot permutation matmul applied
+  to the block's four byte planes (integers 0..255 are exact in bf16, so
+  the permutation is bit-exact on int32/f32 data),
+exactly the trade SURVEY §7 prescribes (scatter -> one-hot matmul).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLK = 1024  # columns (data rows) per streamed chunk
+_LANE = 128  # DMA lane-alignment quantum
+
+
+def num_words(num_features: int) -> int:
+    return -(-num_features // 4)
+
+
+def num_channels(num_features: int, num_score: int = 1, with_weight: bool = True) -> int:
+    """Total padded channel count: W words + g,h,sel + num_score scores +
+    label + rowid (+ weight), padded to a multiple of 8 (DMA sublane
+    tiling)."""
+    c = num_words(num_features) + 3 + num_score + 2 + (1 if with_weight else 0)
+    return -(-c // 8) * 8
+
+
+class PLayout:
+    """Channel-row indices inside the packed matrix."""
+
+    def __init__(self, num_features: int, num_score: int = 1, with_weight: bool = True):
+        self.F = num_features
+        self.W = num_words(num_features)
+        self.G = self.W
+        self.H = self.W + 1
+        self.SEL = self.W + 2
+        self.SCORE = self.W + 3  # .. SCORE + num_score - 1
+        self.num_score = num_score
+        self.LABEL = self.SCORE + num_score
+        self.ROWID = self.LABEL + 1
+        self.WEIGHT = self.ROWID + 1 if with_weight else -1
+        self.with_weight = with_weight
+        self.C = num_channels(num_features, num_score, with_weight)
+
+
+def pack_matrix(bins: np.ndarray, layout: PLayout, label=None, weight=None) -> jnp.ndarray:
+    """Build the (C, N + BLK) packed matrix from (N, F) uint8 bins.
+
+    The BLK tail columns absorb block-granular DMA overruns.  grad/hess
+    start at 0, select at 1, scores at 0; rowid is the original row
+    index (prediction / eval unscrambling)."""
+    n, f = bins.shape
+    assert f == layout.F
+    assert bins.dtype == np.uint8, "partitioned path requires max_bin <= 256"
+    w = layout.W
+    pad_f = w * 4 - f
+    bb = np.pad(np.asarray(bins), ((0, 0), (0, pad_f))).astype(np.uint32)
+    bb = bb.reshape(n, w, 4)
+    words = (
+        bb[:, :, 0]
+        | (bb[:, :, 1] << 8)
+        | (bb[:, :, 2] << 16)
+        | (bb[:, :, 3] << 24)
+    ).astype(np.uint32).view(np.int32)
+    P = np.zeros((layout.C, n + BLK), np.int32)
+    P[:w, :n] = words.T
+    one = np.float32(1.0).view(np.int32)
+    P[layout.SEL, :n] = one
+    if label is not None:
+        P[layout.LABEL, :n] = np.asarray(label, np.float32).view(np.int32)
+    P[layout.ROWID, :n] = np.arange(n, dtype=np.int32)
+    if layout.with_weight:
+        wv = np.ones(n, np.float32) if weight is None else np.asarray(weight, np.float32)
+        P[layout.WEIGHT, :n] = wv.view(np.int32)
+    return jnp.asarray(P)
+
+
+def _tri_np() -> np.ndarray:
+    """(BLK, BLK) upper-triangular ones: dot(v, tri)[d] = cumsum_{s<=d} v[s]."""
+    i = np.arange(BLK)
+    return (i[:, None] <= i[None, :]).astype(np.float32)
+
+
+_TRI_NP = None
+
+
+def _get_tri():
+    """bf16 triangular constant; numpy-backed so traced calls never cache
+    a tracer."""
+    global _TRI_NP
+    if _TRI_NP is None:
+        _TRI_NP = _tri_np()
+    return jnp.asarray(_TRI_NP, jnp.bfloat16)
+
+
+def _planes(blk_i32, c):
+    """(C, BLK) int32 -> (4C, BLK) bf16 byte planes (exact in bf16)."""
+    ps = [(blk_i32 >> (8 * k)) & 255 for k in range(4)]
+    return jnp.concatenate(ps, axis=0).astype(jnp.bfloat16)
+
+
+def _unplanes(dots_f32, c):
+    """(4C, BLK) f32 byte planes -> (C, BLK) int32 (exact repack)."""
+    p = dots_f32.astype(jnp.int32)
+    return (
+        p[0 * c : 1 * c]
+        | (p[1 * c : 2 * c] << 8)
+        | (p[2 * c : 3 * c] << 16)
+        | (p[3 * c : 4 * c] << 24)
+    )
+
+
+# ======================================================================
+# histogram kernel
+# ======================================================================
+def _hist_kernel(sref, p_any, o_ref, acc_ref, buf_ref, sem, *, nf, nb, w, c, fchunk):
+    start = sref[0]
+    cnt = sref[1]
+    base = pl.multiple_of((start // BLK) * BLK, _LANE)
+    head = start - base
+    nblk = (head + cnt + BLK - 1) // BLK
+    acc_ref[:, :] = jnp.zeros_like(acc_ref)
+
+    def get_dma(slot, j):
+        return pltpu.make_async_copy(
+            p_any.at[:, pl.ds(base + j * BLK, BLK)], buf_ref.at[slot], sem.at[slot]
+        )
+
+    get_dma(0, 0).start()
+
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (nb, BLK), 0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, BLK), 1)
+
+    def body(j, _):
+        slot = jax.lax.rem(j, 2)
+
+        @pl.when(j + 1 < nblk)
+        def _():
+            get_dma(1 - slot, j + 1).start()
+
+        get_dma(slot, j).wait()
+        blk = buf_ref[slot]
+        pos = lane + j * BLK
+        valid = ((pos >= head) & (pos < head + cnt)).astype(jnp.float32)
+        sel = pltpu.bitcast(blk[w + 2 : w + 3, :], jnp.float32) * valid
+        g = pltpu.bitcast(blk[w : w + 1, :], jnp.float32) * sel
+        h = pltpu.bitcast(blk[w + 1 : w + 2, :], jnp.float32) * sel
+
+        # f32 fidelity at bf16 speed: x = hi + mid + lo (3 bf16 terms);
+        # the dot's N dim pads to 128 lanes so extra value rows are free.
+        def split3(x):
+            hi = x.astype(jnp.bfloat16)
+            r1 = x - hi.astype(jnp.float32)
+            mid = r1.astype(jnp.bfloat16)
+            lo = (r1 - mid.astype(jnp.float32)).astype(jnp.bfloat16)
+            return hi, mid, lo
+
+        g3 = split3(g)
+        h3 = split3(h)
+        vals = jnp.concatenate(list(g3) + list(h3) + [sel.astype(jnp.bfloat16)], axis=0)
+
+        for c0 in range(0, nf, fchunk):
+            c1 = min(c0 + fchunk, nf)
+            chunks = []
+            for f in range(c0, c1):
+                wd, p4 = divmod(f, 4)
+                byte = (blk[wd : wd + 1, :] >> (p4 * 8)) & 255
+                chunks.append((byte == iota_b).astype(jnp.bfloat16))
+            oh = jnp.concatenate(chunks, axis=0)
+            # (7, BLK) x (F_c*B, BLK) -> (7, F_c*B): value rows on sublanes
+            # so the accumulator/output is (8, F*B) — lane-major, which
+            # copies out clean (an (F*B, 7) output pays a strided
+            # VMEM->HBM copy measured at ~2 ms).
+            acc_ref[0:7, c0 * nb : c1 * nb] += jax.lax.dot_general(
+                vals, oh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+        return 0
+
+    jax.lax.fori_loop(0, nblk, body, 0, unroll=False)
+    o_ref[:, :] = acc_ref[:, :]
+
+
+@functools.partial(jax.jit, static_argnames=("num_features", "num_bins", "interpret"))
+def hist_dyn(p, start, cnt, num_features, num_bins, interpret=False):
+    """(F, B, 3) histogram of the leaf segment [start, start+cnt) of the
+    packed matrix ``p`` — DenseBin::ConstructHistogram (dense_bin.hpp:66)
+    over the leaf's contiguous rows, streamed at HBM bandwidth."""
+    w = num_words(num_features)
+    c = p.shape[0]
+    fb = num_features * num_bins
+    fchunk = max(1, min(num_features, 512 // num_bins))
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, nf=num_features, nb=num_bins, w=w, c=c, fchunk=fchunk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(1,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((8, fb), jnp.float32),
+                pltpu.VMEM((2, c, BLK), jnp.int32),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((8, fb), jnp.float32),
+        interpret=interpret,
+    )(jnp.stack([jnp.int32(start), jnp.int32(cnt)]), p)
+    hist = jnp.stack(
+        [
+            out[0] + (out[1] + out[2]),
+            out[3] + (out[4] + out[5]),
+            out[6],
+        ],
+        axis=1,
+    )
+    return hist.reshape(num_features, num_bins, 3)
+
+
+# ======================================================================
+# partition kernel
+# ======================================================================
+def _stream_flush(stage, wsem, dst_any, merged, nstart, dst_off):
+    """Start one aligned BLK write via the double-buffered stage.  Caller
+    guarantees wait-before-reuse via _stage_wait."""
+    slot = jax.lax.rem(nstart, 2)
+
+    @pl.when(nstart >= 2)
+    def _():
+        pltpu.make_async_copy(stage.at[slot], stage.at[slot], wsem.at[slot]).wait()
+
+    stage[slot] = merged
+    pltpu.make_async_copy(
+        stage.at[slot], dst_any.at[:, pl.ds(dst_off, BLK)], wsem.at[slot]
+    ).start()
+
+
+def _stream_drain(stage, wsem, nstarts):
+    @pl.when(nstarts >= 1)
+    def _():
+        pltpu.make_async_copy(stage.at[0], stage.at[0], wsem.at[0]).wait()
+
+    @pl.when(nstarts >= 2)
+    def _():
+        pltpu.make_async_copy(stage.at[1], stage.at[1], wsem.at[1]).wait()
+
+
+def _part_kernel(
+    sref, tri_ref, p_in, s_in, p_any, s_any, nl_ref,
+    buf, carL, carR, stageL, stageR, tmp, rsem, csem, wsemL, wsemR, *, c,
+):
+    start = sref[0]
+    cnt = sref[1]
+    word = sref[2]
+    shift = sref[3]
+    zero_bin = sref[4]
+    dbz = sref[5]
+    thr = sref[6]
+    is_cat = sref[7]
+    base = pl.multiple_of((start // BLK) * BLK, _LANE)
+    head = start - base
+    nblk = (head + cnt + BLK - 1) // BLK
+
+    def get_read(slot, j):
+        return pltpu.make_async_copy(
+            p_any.at[:, pl.ds(base + j * BLK, BLK)], buf.at[slot], rsem.at[slot]
+        )
+
+    get_read(0, 0).start()
+    # preload the left carry with the existing head block: lanes < head are
+    # preserved verbatim through the first flush (the in-place RMW head).
+    pltpu.make_async_copy(p_any.at[:, pl.ds(base, BLK)], carL, csem).start()
+    pltpu.make_async_copy(p_any.at[:, pl.ds(base, BLK)], carL, csem).wait()
+
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, BLK), 1)
+    iota_c = jax.lax.broadcasted_iota(jnp.int32, (c, 1), 0)
+    iota_d = jax.lax.broadcasted_iota(jnp.int32, (BLK, BLK), 0)
+    tri = tri_ref[:, :]
+
+    def body(j, st):
+        cl, fl, cr, fr = st
+        slot = jax.lax.rem(j, 2)
+
+        @pl.when(j + 1 < nblk)
+        def _():
+            get_read(1 - slot, j + 1).start()
+
+        get_read(slot, j).wait()
+        blk = buf[slot]
+        pos = lane + j * BLK
+        valid = (pos >= head) & (pos < head + cnt)
+        wordrow = jnp.sum(jnp.where(iota_c == word, blk, 0), axis=0, keepdims=True)
+        binv = (wordrow >> shift) & 255
+        fv = jnp.where(binv == zero_bin, dbz, binv)
+        eqv = (fv == thr).astype(jnp.int32)
+        lev = (fv <= thr).astype(jnp.int32)
+        gl = (jnp.where(is_cat == 1, eqv, lev) == 1) & valid
+        gr = valid & (~gl)
+
+        glf = gl.astype(jnp.bfloat16)
+        grf = gr.astype(jnp.bfloat16)
+        cumL = jax.lax.dot_general(
+            glf, tri, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        cumR = jax.lax.dot_general(
+            grf, tri, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        cumLi = cumL.astype(jnp.int32)
+        cumRi = cumR.astype(jnp.int32)
+        cntl = jnp.max(cumLi)
+        cntr = jnp.max(cumRi)
+
+        planes = _planes(blk, c)
+
+        def permute(sel_mask, cum_i, coff):
+            tgt = coff + cum_i - 1
+            tgt = tgt - jnp.where(tgt >= BLK, BLK, 0)
+            oh = (sel_mask & (iota_d == tgt)).astype(jnp.bfloat16)  # (D, S) d x s
+            dots = jax.lax.dot_general(
+                planes, oh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )  # (4C, D)
+            return _unplanes(dots, c)
+
+        permL = permute(gl, cumLi, cl)
+        permR = permute(gr, cumRi, cr)
+
+        tL = cl + cntl
+        mergedL = jnp.where(lane < cl, carL[:, :], permL)
+        flushL = tL >= BLK
+
+        @pl.when(flushL)
+        def _():
+            _stream_flush(stageL, wsemL, p_any, mergedL, fl, base + fl * BLK)
+
+        carL[:, :] = jnp.where(flushL, permL, mergedL)
+        cl = jnp.where(flushL, tL - BLK, tL)
+        fl = fl + flushL.astype(jnp.int32)
+
+        tR = cr + cntr
+        mergedR = jnp.where(lane < cr, carR[:, :], permR)
+        flushR = tR >= BLK
+
+        @pl.when(flushR)
+        def _():
+            _stream_flush(stageR, wsemR, s_any, mergedR, fr, fr * BLK)
+
+        carR[:, :] = jnp.where(flushR, permR, mergedR)
+        cr = jnp.where(flushR, tR - BLK, tR)
+        fr = fr + flushR.astype(jnp.int32)
+        return (cl, fl, cr, fr)
+
+    cl, fl, cr, fr = jax.lax.fori_loop(
+        0, nblk, body, (head, jnp.int32(0), jnp.int32(0), jnp.int32(0)), unroll=False
+    )
+
+    # final left flush: read-modify-write the tail block so columns past
+    # the carry fill keep their current bytes (to be overwritten by the
+    # rights copy-back, or beyond-segment data that must survive).
+    pltpu.make_async_copy(p_any.at[:, pl.ds(base + fl * BLK, BLK)], tmp, csem).start()
+    pltpu.make_async_copy(p_any.at[:, pl.ds(base + fl * BLK, BLK)], tmp, csem).wait()
+    mergedL = jnp.where(lane < cl, carL[:, :], tmp[:, :])
+    _stream_flush(stageL, wsemL, p_any, mergedL, fl, base + fl * BLK)
+    # final right flush: whole carry block (garbage tail masked at copy-back)
+    _stream_flush(stageR, wsemR, s_any, carR[:, :], fr, fr * BLK)
+
+    _stream_drain(stageL, wsemL, fl + 1)
+    _stream_drain(stageR, wsemR, fr + 1)
+    nl_ref[0] = fl * BLK + cl - head
+
+
+def _partition_call(p, scratch, tri, sv, interpret=False):
+    c = p.shape[0]
+    nscr = scratch.shape[1]
+    return pl.pallas_call(
+        functools.partial(_part_kernel, c=c),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(1,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.VMEM),  # tri
+                pl.BlockSpec(memory_space=pl.ANY),  # P (alias)
+                pl.BlockSpec(memory_space=pl.ANY),  # scratch (alias)
+            ],
+            out_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((2, c, BLK), jnp.int32),  # read buf
+                pltpu.VMEM((c, BLK), jnp.int32),  # carL
+                pltpu.VMEM((c, BLK), jnp.int32),  # carR
+                pltpu.VMEM((2, c, BLK), jnp.int32),  # stageL
+                pltpu.VMEM((2, c, BLK), jnp.int32),  # stageR
+                pltpu.VMEM((c, BLK), jnp.int32),  # tmp (RMW)
+                pltpu.SemaphoreType.DMA((2,)),  # rsem
+                pltpu.SemaphoreType.DMA(()),  # csem
+                pltpu.SemaphoreType.DMA((2,)),  # wsemL
+                pltpu.SemaphoreType.DMA((2,)),  # wsemR
+            ],
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(p.shape, jnp.int32),
+            jax.ShapeDtypeStruct(scratch.shape, jnp.int32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+        ),
+        input_output_aliases={2: 0, 3: 1},
+        interpret=interpret,
+    )(sv, tri, p, scratch)
+
+
+# ======================================================================
+# copy-back kernel (rights: scratch[0:cntR) -> P[dst: dst+cntR))
+# ======================================================================
+def _copyback_kernel(sref, s_in, p_in, p_any, buf, car, stage, tmp, rsem, csem, wsem, *, c):
+    dst = sref[0]
+    cntr = sref[1]
+    base = pl.multiple_of((dst // BLK) * BLK, _LANE)
+    head = dst - base
+    nblk = (cntr + BLK - 1) // BLK
+    s_any = s_in
+
+    def get_read(slot, j):
+        return pltpu.make_async_copy(
+            s_any.at[:, pl.ds(j * BLK, BLK)], buf.at[slot], rsem.at[slot]
+        )
+
+    get_read(0, 0).start()
+    pltpu.make_async_copy(p_any.at[:, pl.ds(base, BLK)], car, csem).start()
+    pltpu.make_async_copy(p_any.at[:, pl.ds(base, BLK)], car, csem).wait()
+
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, BLK), 1)
+    iota_d = jax.lax.broadcasted_iota(jnp.int32, (BLK, BLK), 0)
+    # constant cyclic shift by `head`: src already compact, so rank = lane
+    tgt = head + lane
+    tgt = tgt - jnp.where(tgt >= BLK, BLK, 0)
+    oh_shift = (iota_d == tgt).astype(jnp.bfloat16)
+
+    def body(j, st):
+        cl, fl = st
+        slot = jax.lax.rem(j, 2)
+
+        @pl.when(j + 1 < nblk)
+        def _():
+            get_read(1 - slot, j + 1).start()
+
+        get_read(slot, j).wait()
+        blk = buf[slot]
+        n_in = jnp.minimum(cntr - j * BLK, BLK)
+        planes = _planes(blk, c)
+        valid = lane < n_in
+        oh = jnp.where(valid, oh_shift, jnp.bfloat16(0.0))
+        dots = jax.lax.dot_general(
+            planes, oh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        perm = _unplanes(dots, c)
+        t = cl + n_in
+        merged = jnp.where(lane < cl, car[:, :], perm)
+        flush = t >= BLK
+
+        @pl.when(flush)
+        def _():
+            _stream_flush(stage, wsem, p_any, merged, fl, base + fl * BLK)
+
+        car[:, :] = jnp.where(flush, perm, merged)
+        cl = jnp.where(flush, t - BLK, t)
+        fl = fl + flush.astype(jnp.int32)
+        return (cl, fl)
+
+    cl, fl = jax.lax.fori_loop(0, nblk, body, (head, jnp.int32(0)), unroll=False)
+    pltpu.make_async_copy(p_any.at[:, pl.ds(base + fl * BLK, BLK)], tmp, csem).start()
+    pltpu.make_async_copy(p_any.at[:, pl.ds(base + fl * BLK, BLK)], tmp, csem).wait()
+    merged = jnp.where(lane < cl, car[:, :], tmp[:, :])
+    _stream_flush(stage, wsem, p_any, merged, fl, base + fl * BLK)
+    _stream_drain(stage, wsem, fl + 1)
+
+
+def _copyback_call(p, scratch, sv, interpret=False):
+    c = p.shape[0]
+    return pl.pallas_call(
+        functools.partial(_copyback_kernel, c=c),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(1,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),  # scratch (read)
+                pl.BlockSpec(memory_space=pl.ANY),  # P (alias)
+            ],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[
+                pltpu.VMEM((2, c, BLK), jnp.int32),
+                pltpu.VMEM((c, BLK), jnp.int32),  # carry
+                pltpu.VMEM((2, c, BLK), jnp.int32),  # stage
+                pltpu.VMEM((c, BLK), jnp.int32),  # tmp
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct(p.shape, jnp.int32),
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )(sv, scratch, p)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def partition_segment(p, scratch, start, cnt, word, shift, zero_bin, dbz, thr, is_cat, interpret=False):
+    """Stable-partition the leaf segment [start, start+cnt) of ``p`` by
+    the split predicate (DataPartition::Split, data_partition.hpp:94-150,
+    fused with the DefaultValueForZero bin remap of dense_bin.hpp:191-232).
+
+    Lefts land at [start, start+nl), rights at [start+nl, start+cnt),
+    in place.  Returns (p', scratch', nl)."""
+    sv = jnp.stack(
+        [
+            jnp.int32(start), jnp.int32(cnt), jnp.int32(word), jnp.int32(shift),
+            jnp.int32(zero_bin), jnp.int32(dbz), jnp.int32(thr), jnp.int32(is_cat),
+        ]
+    )
+    tri = _get_tri()
+    p, scratch, nl = _partition_call(p, scratch, tri, sv, interpret=interpret)
+    nl = nl[0]
+    cntr = cnt - nl
+    sv2 = jnp.stack([jnp.int32(start) + nl, cntr])
+    p = _copyback_call(p, scratch, sv2, interpret=interpret)
+    return p, scratch, nl
+
+
+# ======================================================================
+# pure-XLA reference implementations (CPU tests / documentation)
+# ======================================================================
+def unpack_bins(p, layout: PLayout, n: int) -> jnp.ndarray:
+    """(N, F) uint8 bins recovered from the packed words (test helper)."""
+    w = layout.W
+    words = p[:w, :n]  # (W, N)
+    cols = []
+    for f in range(layout.F):
+        wd, p4 = divmod(f, 4)
+        cols.append((words[wd] >> (p4 * 8)) & 255)
+    return jnp.stack(cols, axis=1).astype(jnp.uint8)
+
+
+def hist_ref(p, start: int, cnt: int, layout: PLayout, num_bins: int) -> jnp.ndarray:
+    """Reference (XLA) histogram of a segment — same contract as hist_dyn."""
+    from .histogram import build_histogram
+
+    seg = p[:, start : start + cnt]
+    bins = unpack_bins(seg, layout, cnt)
+    g = jax.lax.bitcast_convert_type(seg[layout.G], jnp.float32)
+    h = jax.lax.bitcast_convert_type(seg[layout.H], jnp.float32)
+    sel = jax.lax.bitcast_convert_type(seg[layout.SEL], jnp.float32)
+    return build_histogram(bins, g, h, sel, num_bins)
+
+
+def partition_ref(p, start: int, cnt: int, feat: int, zero_bin: int, dbz: int, thr: int, is_cat: bool, layout: PLayout):
+    """Reference (numpy) stable partition — same contract as
+    partition_segment."""
+    pn = np.asarray(p)
+    seg = pn[:, start : start + cnt]
+    wd, p4 = divmod(feat, 4)
+    binv = (seg[wd] >> (p4 * 8)) & 255
+    fv = np.where(binv == zero_bin, dbz, binv)
+    gl = (fv == thr) if is_cat else (fv <= thr)
+    out = np.concatenate([seg[:, gl], seg[:, ~gl]], axis=1)
+    pn = pn.copy()
+    pn[:, start : start + cnt] = out
+    return jnp.asarray(pn), int(gl.sum())
