@@ -16,6 +16,8 @@ from .gbdt import GBDT
 
 
 class DART(GBDT):
+    supports_partitioned = False  # host-side drop/normalize hooks
+
     def init(self, config, train_set, objective, training_metrics=()):
         super().init(config, train_set, objective, training_metrics)
         self.random_for_drop = Random(config.drop_seed)

@@ -35,6 +35,11 @@ K_MIN_SCORE = -np.inf
 class GBDT:
     """The gradient-boosting driver (class GBDT, gbdt.h:24-258)."""
 
+    # DART/GOSS override: their per-iteration hooks (drop/normalize,
+    # gradient resampling) are host-side and incompatible with the fused
+    # partitioned trainer.
+    supports_partitioned = True
+
     def __init__(self):
         self.models: List[Tree] = []
         self.iter = 0
@@ -123,6 +128,18 @@ class GBDT:
             self.fast_grower = FastGrower(
                 train_set.binned, self.meta, self.hyper, self.grow_params
             )
+
+        # Partitioned fused trainer (ops/pgrow.py): the TPU fast path for
+        # serial single-class training with a row-local objective.
+        self.ptrainer = None
+        if self.learner is None and self.fast_grower is None and self.supports_partitioned:
+            from .ptrainer import PartitionedTrainer, eligible as _pt_eligible
+
+            if _pt_eligible(config, train_set, objective, self.num_tree_per_iteration):
+                self.ptrainer = PartitionedTrainer(
+                    train_set, config, objective, self.meta, self.hyper
+                )
+                Log.info("Using partitioned (fused) TPU tree learner")
         k = self.num_tree_per_iteration
         self.scores = jnp.zeros((k, self.num_data), jnp.float32)
         init_score = train_set.metadata.init_score
@@ -208,6 +225,8 @@ class GBDT:
             tree = Tree.constant(init_score)
             self.scores = self.scores + jnp.float32(init_score)
             self.valid_scores = [vs + jnp.float32(init_score) for vs in self.valid_scores]
+            if self.ptrainer is not None:
+                self.ptrainer.add_score_constant(init_score)
             self.models.append(tree)
             self.boost_from_average_ = True
             Log.info("Start training from score %f", init_score)
@@ -253,6 +272,9 @@ class GBDT:
         """One boosting iteration (GBDT::TrainOneIter, gbdt.cpp:381-495).
         Returns True when training should stop."""
         from ..utils.profiling import timetag
+
+        if self.ptrainer is not None and gradients is None:
+            return self.train_iters_partitioned(1, is_eval=is_eval)
 
         self._boost_from_average()
 
@@ -322,6 +344,44 @@ class GBDT:
             return True
 
         self.iter += 1
+        if self.ptrainer is not None:
+            # scores advanced outside the partitioned channel
+            self.ptrainer.score_dirty = True
+        if is_eval:
+            return self.eval_and_check_early_stopping()
+        return False
+
+    def train_iters_partitioned(self, num_iters: int, is_eval: bool = True) -> bool:
+        """Run ``num_iters`` boosting iterations through the fused
+        partitioned trainer (one device program, no per-iteration host
+        round-trips).  Returns True when training should stop."""
+        from ..utils.profiling import timetag
+
+        if num_iters <= 0:
+            return False
+        self._boost_from_average()
+        pt = self.ptrainer
+        if pt.score_dirty:
+            pt.sync_scores_from(self.scores[0])
+        with timetag.phase("tree"):
+            recs, scores_orig, n_done = pt.train_chunk(
+                num_iters, self.shrinkage_rate, self.iter
+            )
+        with timetag.phase("train_score"):
+            self.scores = scores_orig[None, :]
+        for t in range(n_done):
+            tree = Tree.from_grow_result(pt.grow_result_view(recs, t), self.train_set)
+            tree.shrinkage(self.shrinkage_rate)
+            self.models.append(tree)
+            with timetag.phase("valid_score"):
+                self._add_tree_to_valid_scores(tree, 0)
+        self.iter += n_done
+        if n_done < num_iters:
+            Log.warning(
+                "Stopped training because there are no more leaves that meet "
+                "the split requirements."
+            )
+            return True
         if is_eval:
             return self.eval_and_check_early_stopping()
         return False
@@ -391,6 +451,12 @@ class GBDT:
                 )
         del self.models[-k:]
         self.iter -= 1
+        if self.ptrainer is not None:
+            # keep the partitioned score channel consistent (the segment
+            # layout still matches the popped tree, so this is one cheap
+            # in-place subtract; otherwise resync lazily)
+            if not self.ptrainer.rollback_last():
+                self.ptrainer.score_dirty = True
 
     # ------------------------------------------------------------------
     def eval_and_check_early_stopping(self) -> bool:
@@ -478,6 +544,12 @@ class GBDT:
         self.hyper = SplitHyper.from_config(self.config)
         if self.fast_grower is not None:
             self.fast_grower.hyper = self.hyper
+        if self.ptrainer is not None:
+            # the compiled chunk programs bake hyper/config in as closure
+            # constants — swap state and drop the program cache
+            self.ptrainer.hyper = self.hyper
+            self.ptrainer.config = self.config
+            self.ptrainer._progs.clear()
         self.shrinkage_rate = self.config.learning_rate
         self.is_bagging = (
             self.config.bagging_fraction < 1.0 and self.config.bagging_freq > 0

@@ -17,6 +17,8 @@ from .gbdt import GBDT
 
 
 class GOSS(GBDT):
+    supports_partitioned = False  # host-side gradient resampling hooks
+
     def init(self, config, train_set, objective, training_metrics=()):
         super().init(config, train_set, objective, training_metrics)
         if config.bagging_freq > 0 and config.bagging_fraction != 1.0:

@@ -1,0 +1,343 @@
+"""Fused partitioned trainer — boosting iterations as ONE device program.
+
+Drives ops/pgrow.py for the serial single-class path.  The motivation is
+dispatch latency: a host round-trip to the (possibly tunneled) TPU costs
+up to ~80 ms, so the reference's per-iteration host loop
+(GBDT::TrainOneIter, gbdt.cpp:381-495) becomes a ``lax.fori_loop`` over
+iterations INSIDE one jitted program:
+
+    gradients (from the score/label channels, in permuted row space)
+    -> bagging mask -> feature sampling -> grow_tree_partitioned
+    -> in-place per-segment score update -> split records[t]
+
+Scores, labels and weights travel as bitcast channels of the packed
+matrix, so nothing is ever gathered back to original row order during
+training; the (N,) original-order score vector is rebuilt ONCE per chunk
+(a single scatter through the rowid channel) for metrics/eval.
+
+Row-order-free semantics this relies on: histograms, leaf statistics and
+elementwise objectives are permutation-invariant.  Ranking objectives
+(query-grouped) are not — they keep the mask-based grower (ops/grow.py).
+
+Deliberate parity divergences from the reference (documented):
+- bagging draws a per-row Bernoulli(bagging_fraction) mask with JAX
+  threefry instead of the host RNG's exact-count subset
+  (gbdt.cpp:275-334); same distribution, different stream.
+- feature_fraction samples exactly ceil(frac*F) features via device
+  top_k on uniform keys instead of utils/random.py's host sampler.
+"""
+
+from __future__ import annotations
+
+import functools
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.pgrow import (
+    PGrowParams,
+    grow_tree_partitioned,
+    segment_values,
+)
+from ..ops.pkernels import PLayout, pack_matrix
+from ..ops.split import FeatureMeta, SplitHyper
+from ..utils.log import Log
+
+
+def _f2i(x):
+    return jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.int32)
+
+
+def _i2f(x):
+    return jax.lax.bitcast_convert_type(x, jnp.float32)
+
+
+class PartitionedTrainer:
+    """Owns the packed matrix + fused train-chunk programs for one GBDT."""
+
+    def __init__(self, train_set, config, objective, meta: FeatureMeta, hyper: SplitHyper):
+        binned = np.asarray(train_set.binned)
+        n, f = binned.shape
+        assert binned.dtype == np.uint8
+        md = train_set.metadata
+        self.has_weights = md.weights is not None
+        self.layout = PLayout(f, num_score=1, with_weight=True)
+        self.p = pack_matrix(binned, self.layout, label=md.label,
+                             weight=md.weights if self.has_weights else None)
+        self.scratch = jnp.zeros_like(self.p)
+        self.num_rows = n
+        self.meta = meta
+        self.hyper = hyper
+        self.objective = objective
+        self.config = config
+        self.params = PGrowParams(
+            num_leaves=max(2, int(config.num_leaves)),
+            num_bins=int(train_set.max_num_bin),
+            num_features=f,
+            num_rows=n,
+            max_depth=int(config.max_depth),
+            use_missing=bool(config.use_missing),
+            has_categorical=bool(np.any(np.asarray(meta.is_categorical))),
+        )
+        self.interpret = jax.default_backend() != "tpu"
+        # start dirty: init_score / init_model may mutate GBDT.scores after
+        # construction; the first chunk syncs the channel (identity-order
+        # gather, cheap)
+        self.score_dirty = True
+        self._progs = {}
+        self._last_tree = None  # (starts, cnts, scaled leaf deltas) for rollback
+        self._base_key = jax.random.PRNGKey(
+            (int(config.bagging_seed) << 1) ^ int(config.feature_fraction_seed)
+        )
+
+    # -- score channel maintenance ------------------------------------
+    def add_score_constant(self, c: float) -> None:
+        lay = self.layout
+        sc = _i2f(self.p[lay.SCORE]) + jnp.float32(c)
+        self.p = self.p.at[lay.SCORE].set(_f2i(sc))
+
+    def sync_scores_from(self, scores_orig) -> None:
+        """Permute an original-order (N,) score vector into the channel
+        (one gather through rowid; rare — init_model / external updates)."""
+        lay = self.layout
+        rowid = self.p[lay.ROWID, : self.num_rows]
+        perm = jnp.asarray(scores_orig, jnp.float32)[rowid]
+        padded = jnp.zeros((self.p.shape[1],), jnp.float32).at[: self.num_rows].set(perm)
+        self.p = self.p.at[lay.SCORE].set(_f2i(padded))
+        self.score_dirty = False
+
+    def scores_original_order(self):
+        lay = self.layout
+        rowid = self.p[lay.ROWID, : self.num_rows]
+        sc = _i2f(self.p[lay.SCORE, : self.num_rows])
+        return jnp.zeros((self.num_rows,), jnp.float32).at[rowid].set(sc)
+
+    def rollback_last(self) -> bool:
+        """Undo the most recent tree's score contribution (the segment
+        layout still matches it — GBDT::RollbackOneIter)."""
+        if self._last_tree is None:
+            return False
+        delta = self._last_tree
+        lay = self.layout
+        sc = _i2f(self.p[lay.SCORE, : self.num_rows]) - delta
+        full = jnp.zeros((self.p.shape[1],), jnp.float32).at[: self.num_rows].set(sc)
+        self.p = self.p.at[lay.SCORE].set(_f2i(full))
+        self._last_tree = None
+        return True
+
+    # -- the fused chunk program --------------------------------------
+    def _grad_fn(self, score, label, weight):
+        obj = self.objective
+        return obj.gradients_rowwise(score, label, weight if self.has_weights else None)
+
+    def _build_program(self, T: int, bag_on: bool, bag_freq: int, used_features: int):
+        lay = self.layout
+        n = self.num_rows
+        L = self.params.num_leaves
+        F = self.params.num_features
+        grad_fn = self._grad_fn
+        params = self.params
+        meta = self.meta
+        hyper = self.hyper
+        interpret = self.interpret
+        bag_frac = float(self.config.bagging_fraction)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def prog(p, scratch, lr, key, iter0, t_run):
+            ones_sel = jnp.full((n,), np.float32(1.0).view(np.int32), jnp.int32)
+            pad = p.shape[1] - n
+
+            def row(x_i32):
+                return jnp.concatenate([x_i32, jnp.zeros((pad,), jnp.int32)])[None, :]
+
+            def one_iter(t, carry):
+                (p, scratch, recs, stopped, last_starts, last_cnts, last_vals, last_ns) = carry
+                it = iter0 + t
+                # gradients from channels
+                score = _i2f(p[lay.SCORE, :n])
+                label = _i2f(p[lay.LABEL, :n])
+                weight = _i2f(p[lay.WEIGHT, :n])
+                g, h = grad_fn(score, label, weight)
+                if bag_on:
+                    bkey = jax.random.fold_in(key, 2 * (it // bag_freq))
+                    sel = jax.random.bernoulli(bkey, bag_frac, (n,)).astype(jnp.float32)
+                    sel_i = _f2i(sel)
+                else:
+                    sel_i = ones_sel
+                # rebuild P functionally (concat, not .at[row].set): row
+                # surgery on the 64 MB loop carry trips XLA's in-place
+                # elision and costs whole-array copies per write; a clean
+                # rebuild is one materialization (~0.2 ms)
+                p = jnp.concatenate(
+                    [p[: lay.G], row(_f2i(g)), row(_f2i(h)), row(sel_i), p[lay.SCORE :]],
+                    axis=0,
+                )
+
+                if used_features < F:
+                    fkey = jax.random.fold_in(key, 2 * it + 1)
+                    u = jax.random.uniform(fkey, (F,))
+                    _, idx = jax.lax.top_k(u, used_features)
+                    fmask = jnp.zeros((F,), jnp.float32).at[idx].set(1.0)
+                else:
+                    fmask = jnp.ones((F,), jnp.float32)
+
+                tree, p, scratch = grow_tree_partitioned(
+                    p, scratch, fmask, meta, hyper, params, interpret=interpret
+                )
+
+                # score update: +lr * leaf_value over each segment.  Once
+                # any iteration produces an empty tree, training has
+                # logically stopped (GBDT::TrainOneIter returns finished;
+                # the host truncates the records there) — later in-program
+                # iterations must not touch the scores either, or the
+                # channel would contain trees that are not in the model.
+                keep = ((tree.num_splits > 0) & (~stopped)).astype(jnp.float32)
+                delta = segment_values(tree, n, lr * keep * tree.leaf_value)
+                score2 = _i2f(p[lay.SCORE, :n]) + delta
+                p = jnp.concatenate(
+                    [p[: lay.SCORE], row(_f2i(score2)), p[lay.SCORE + 1 :]], axis=0
+                )
+
+                recs = {
+                    "num_splits": recs["num_splits"].at[t].set(tree.num_splits),
+                    "leaf": recs["leaf"].at[t].set(tree.rec_leaf),
+                    "feat": recs["feat"].at[t].set(tree.rec_feat),
+                    "thr": recs["thr"].at[t].set(tree.rec_thr),
+                    "dbz": recs["dbz"].at[t].set(tree.rec_dbz),
+                    "gain": recs["gain"].at[t].set(tree.rec_gain),
+                    "lval": recs["lval"].at[t].set(tree.rec_lval),
+                    "rval": recs["rval"].at[t].set(tree.rec_rval),
+                    "lcnt": recs["lcnt"].at[t].set(tree.rec_lcnt),
+                    "rcnt": recs["rcnt"].at[t].set(tree.rec_rcnt),
+                    "ival": recs["ival"].at[t].set(tree.rec_internal_value),
+                }
+                kept = keep > 0
+                new_stopped = stopped | (tree.num_splits == 0)
+                pick = lambda a, b: jnp.where(kept, a, b)
+                return (p, scratch, recs, new_stopped,
+                        pick(tree.starts, last_starts), pick(tree.cnts, last_cnts),
+                        pick(lr * keep * tree.leaf_value, last_vals),
+                        pick(tree.num_splits, last_ns))
+
+            m = L - 1
+            recs0 = {
+                "num_splits": jnp.zeros((T,), jnp.int32),
+                "leaf": jnp.zeros((T, m), jnp.int32),
+                "feat": jnp.zeros((T, m), jnp.int32),
+                "thr": jnp.zeros((T, m), jnp.int32),
+                "dbz": jnp.zeros((T, m), jnp.int32),
+                "gain": jnp.zeros((T, m)),
+                "lval": jnp.zeros((T, m)),
+                "rval": jnp.zeros((T, m)),
+                "lcnt": jnp.zeros((T, m)),
+                "rcnt": jnp.zeros((T, m)),
+                "ival": jnp.zeros((T, m)),
+            }
+            carry0 = (p, scratch, recs0, jnp.array(False),
+                      jnp.zeros((L,), jnp.int32),
+                      jnp.zeros((L,), jnp.int32), jnp.zeros((L,)), jnp.int32(0))
+            p, scratch, recs, _, ls, lc, lv, lns = jax.lax.fori_loop(
+                0, jnp.minimum(t_run, T), one_iter, carry0
+            )
+            # original-order scores for eval (one scatter per chunk)
+            rowid = p[lay.ROWID, :n]
+            sc = _i2f(p[lay.SCORE, :n])
+            scores_orig = jnp.zeros((n,), jnp.float32).at[rowid].set(sc)
+            # last tree's per-position contribution (for rollback)
+            last_delta = segment_values(
+                types.SimpleNamespace(starts=ls, cnts=lc, num_splits=lns), n, lv
+            )
+            return p, scratch, recs, scores_orig, last_delta
+
+        return prog
+
+    # record buffers are allocated at CHUNK_ALLOC granularity so a short
+    # run (warmup) and a long run reuse one compiled program (the loop
+    # bound is traced)
+    CHUNK_ALLOC = 64
+
+    def train_chunk(self, T: int, lr: float, iter0: int):
+        """Run T fused boosting iterations (T <= CHUNK_ALLOC per call is
+        one program invocation; longer runs loop).  Returns (records dict
+        of numpy arrays, scores_orig (N,) device array, n_done)."""
+        cfg = self.config
+        bag_on = cfg.bagging_fraction < 1.0 and cfg.bagging_freq > 0
+        bag_freq = max(1, int(cfg.bagging_freq))
+        used_features = self.params.num_features
+        if cfg.feature_fraction < 1.0:
+            used_features = max(1, int(self.params.num_features * cfg.feature_fraction))
+        # fixed allocation: every chunk size shares ONE compiled program
+        # (the loop bound is traced; record buffers are CHUNK_ALLOC-sized)
+        alloc = self.CHUNK_ALLOC
+        pkey = (alloc, bag_on, bag_freq, used_features)
+        if pkey not in self._progs:
+            self._progs[pkey] = self._build_program(alloc, bag_on, bag_freq, used_features)
+        prog = self._progs[pkey]
+        recs_np = None
+        n_done = 0
+        remaining = T
+        scores_orig = None
+        if T <= 0:
+            return {}, self.scores_original_order(), 0
+        while remaining > 0:
+            step = min(remaining, alloc)
+            self.p, self.scratch, recs, scores_orig, last_delta = prog(
+                self.p, self.scratch, jnp.float32(lr), self._base_key,
+                jnp.int32(iter0 + n_done), jnp.int32(step),
+            )
+            self._last_tree = last_delta
+            part = jax.device_get(recs)
+            ns = part["num_splits"][:step]
+            stop = np.nonzero(ns == 0)[0]
+            done_here = int(stop[0]) if stop.size else step
+            part = {k: v[:done_here] for k, v in part.items()}
+            recs_np = part if recs_np is None else {
+                k: np.concatenate([recs_np[k], part[k]]) for k in part
+            }
+            n_done += done_here
+            remaining -= step
+            if done_here < step:
+                break
+        return recs_np, scores_orig, n_done
+
+    def grow_result_view(self, recs_np, t):
+        """GrowResult-like view of tree t's records (Tree.from_grow_result
+        consumes exactly these fields)."""
+        return types.SimpleNamespace(
+            num_splits=recs_np["num_splits"][t],
+            rec_leaf=recs_np["leaf"][t],
+            rec_feat=recs_np["feat"][t],
+            rec_thr=recs_np["thr"][t],
+            rec_dbz=recs_np["dbz"][t],
+            rec_gain=recs_np["gain"][t],
+            rec_lval=recs_np["lval"][t],
+            rec_rval=recs_np["rval"][t],
+            rec_lcnt=recs_np["lcnt"][t],
+            rec_rcnt=recs_np["rcnt"][t],
+            rec_internal_value=recs_np["ival"][t],
+        )
+
+
+def eligible(config, train_set, objective, num_tree_per_iteration: int) -> bool:
+    """Can the partitioned trainer drive this configuration?  (The rest
+    falls back to the mask-based grower, which handles everything.)"""
+    import os
+
+    flag = os.environ.get("LIGHTGBM_TPU_PGROW", "")
+    if flag == "0":
+        return False
+    if flag != "force" and jax.default_backend() != "tpu":
+        return False
+    if objective is None or num_tree_per_iteration != 1:
+        return False
+    if not getattr(objective, "rowwise", False):
+        return False
+    if config.tree_learner != "serial":
+        return False
+    if np.asarray(train_set.binned).dtype != np.uint8:
+        return False
+    if train_set.max_num_bin > 256:
+        return False
+    return True

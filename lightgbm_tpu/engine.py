@@ -98,6 +98,29 @@ def train(
     cbs_before = sorted(cbs_before, key=lambda c: getattr(c, "order", 0))
     cbs_after = sorted(cbs_after, key=lambda c: getattr(c, "order", 0))
 
+    # Fused fast path: with no per-iteration host decisions (no valid
+    # sets, no custom objective, no before-iteration callbacks, no early
+    # stopping) the whole run executes as chunked device programs —
+    # per-iteration host round-trips cost ~80 ms on a tunneled TPU.
+    ptrainer = getattr(booster.boosting, "ptrainer", None)
+    if (
+        ptrainer is not None
+        and fobj is None
+        and not name_list
+        and not cbs_before
+        and not (early_stopping_rounds and early_stopping_rounds > 0)
+    ):
+        iter_before = booster.boosting.iter
+        booster.boosting.train_iters_partitioned(num_boost_round, is_eval=False)
+        done = booster.boosting.iter - iter_before
+        for i in range(done):
+            for cb in cbs_after:
+                cb(callback_mod.CallbackEnv(booster, params, i, 0, num_boost_round, []))
+        if done < num_boost_round:
+            Log.info("Finished training with %d iterations", done)
+        booster.best_iteration = booster.current_iteration()
+        return booster
+
     # training loop
     for i in range(num_boost_round):
         for cb in cbs_before:

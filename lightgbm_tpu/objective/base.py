@@ -14,6 +14,11 @@ class ObjectiveFunction:
     """
 
     name = "none"
+    # True when gradients depend only on the row's own (score, label,
+    # weight) — the property the partitioned trainer needs to compute
+    # gradients in permuted row space (boosting/ptrainer.py).  Ranking
+    # objectives (query-grouped pairs) must leave this False.
+    rowwise = False
 
     def init(self, metadata, num_data: int) -> None:
         """Bind label/weight device arrays (ObjectiveFunction::Init)."""
@@ -29,6 +34,22 @@ class ObjectiveFunction:
 
     def get_gradients(self, score):
         raise NotImplementedError
+
+    def gradients_rowwise(self, score, label, weight):
+        """get_gradients with explicit label/weight arrays in ARBITRARY
+        row order (the partitioned trainer's channels).  The default
+        rebinds the bound attributes around get_gradients — valid for
+        any ``rowwise`` objective whose math reads only self.label /
+        self.weights elementwise."""
+        if not self.rowwise:
+            raise NotImplementedError(f"{self.name} is not a row-local objective")
+        old = (getattr(self, "label", None), getattr(self, "weights", None))
+        try:
+            self.label = label
+            self.weights = weight
+            return self.get_gradients(score)
+        finally:
+            self.label, self.weights = old
 
     def convert_output(self, score):
         """Raw score -> prediction space (ConvertOutput); identity default."""

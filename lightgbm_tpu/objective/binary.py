@@ -13,6 +13,7 @@ from .base import ObjectiveFunction
 
 class BinaryLogloss(ObjectiveFunction):
     name = "binary"
+    rowwise = True
 
     def __init__(self, config, is_pos=None):
         self.is_unbalance = bool(config.is_unbalance)
@@ -40,6 +41,8 @@ class BinaryLogloss(ObjectiveFunction):
             else:
                 weight_pos = cnt_negative / cnt_positive
         weight_pos *= self.scale_pos_weight
+        self._weight_pos = float(weight_pos)
+        self._weight_neg = float(weight_neg)
         self.sign = jnp.asarray(np.where(pos_mask, 1.0, -1.0).astype(np.float32))
         self.label_weight = jnp.asarray(
             np.where(pos_mask, weight_pos, weight_neg).astype(np.float32)
@@ -52,6 +55,22 @@ class BinaryLogloss(ObjectiveFunction):
         grad = response * self.label_weight
         hess = abs_response * (self.sigmoid - abs_response) * self.label_weight
         return self._apply_weights(grad, hess)
+
+    def gradients_rowwise(self, score, label, weight):
+        """Row-local variant for the partitioned trainer: sign and class
+        weight recomputed from the label channel (same math as
+        get_gradients; the class-balance scalars come from init)."""
+        pos = self._is_pos(label)
+        sign = jnp.where(pos, 1.0, -1.0)
+        lw = jnp.where(pos, self._weight_pos, self._weight_neg)
+        response = -sign * self.sigmoid / (1.0 + jnp.exp(sign * self.sigmoid * score))
+        abs_response = jnp.abs(response)
+        grad = response * lw
+        hess = abs_response * (self.sigmoid - abs_response) * lw
+        if weight is not None:
+            grad = grad * weight
+            hess = hess * weight
+        return grad, hess
 
     def convert_output(self, score):
         return 1.0 / (1.0 + jnp.exp(-self.sigmoid * score))

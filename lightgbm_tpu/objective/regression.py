@@ -25,6 +25,7 @@ class RegressionL2Loss(ObjectiveFunction):
     """grad = score - label, hess = 1 (regression_objective.hpp:29-44)."""
 
     name = "regression"
+    rowwise = True
 
     def __init__(self, config):
         pass
@@ -48,6 +49,7 @@ class RegressionL1Loss(ObjectiveFunction):
     gaussian_eta (regression_objective.hpp:96-118)."""
 
     name = "regression_l1"
+    rowwise = True
 
     def __init__(self, config):
         self.eta = float(config.gaussian_eta)
@@ -69,6 +71,7 @@ class RegressionHuberLoss(ObjectiveFunction):
     (regression_objective.hpp:169-206)."""
 
     name = "huber"
+    rowwise = True
 
     def __init__(self, config):
         self.delta = float(config.huber_delta)
@@ -94,6 +97,7 @@ class RegressionFairLoss(ObjectiveFunction):
     (regression_objective.hpp:254-272)."""
 
     name = "fair"
+    rowwise = True
 
     def __init__(self, config):
         self.c = float(config.fair_c)
@@ -116,6 +120,7 @@ class RegressionPoissonLoss(ObjectiveFunction):
     (regression_objective.hpp:319-337)."""
 
     name = "poisson"
+    rowwise = True
 
     def __init__(self, config):
         self.max_delta_step = float(config.poisson_max_delta_step)

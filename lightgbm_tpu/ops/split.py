@@ -119,6 +119,7 @@ def best_split_per_feature(
     hyper: SplitHyper,
     feature_mask: jnp.ndarray,
     use_missing: bool = True,
+    has_categorical: bool = True,
 ):
     """Per-feature best split: returns (gain_f, thr_f, dbz_f, left_f) with
     shapes (F,), (F,), (F,), (F, 3).  The per-feature half of
@@ -178,29 +179,40 @@ def best_split_per_feature(
         gain_zr = eval_placement(
             left_zr, (nb[:, None] > 2) & (thr[None, :] != db[:, None])
         )
-        placements = [
-            (gain_zl, left_zl, jnp.zeros_like(db), True),
-            (gain_nat, base, db, True),
-            (gain_zr, left_zr, nb - 1, False),
-        ]
+        # One flattened first-max argmax with the reference's tie order
+        # baked into the axis layout: zero-left before natural before
+        # zero-right (strict > between placements), HIGH threshold
+        # preferred within zl/nat (reversed), LOW within zr — collapses
+        # the 3x (argmax + takes + wheres) cascade, which dominates the
+        # per-split cost inside the grower's while_loop.
+        flat_gain = jnp.concatenate(
+            [gain_zl[:, ::-1], gain_nat[:, ::-1], gain_zr], axis=1
+        )  # (F, 3*(B-1))
+        idx = jnp.argmax(flat_gain, axis=1)
+        best_gain_f = jnp.take_along_axis(flat_gain, idx[:, None], axis=1)[:, 0]
+        pl = idx // (b - 1)
+        off = idx % (b - 1)
+        best_thr_f = jnp.where(pl == 2, off, b - 2 - off).astype(jnp.int32)
+        best_dbz_f = jnp.where(
+            pl == 0, 0, jnp.where(pl == 1, db, nb - 1)
+        ).astype(jnp.int32)
+        left_all = jnp.concatenate([left_zl, base, left_zr], axis=1)  # (F, 3(B-1), 3)
+        lidx = pl * (b - 1) + best_thr_f
+        best_left_f = jnp.take_along_axis(left_all, lidx[:, None, None], axis=1)[:, 0, :]
     else:
         gain_nat = eval_placement(base, always)
-        placements = [(gain_nat, base, db, True)]
+        t_idx = _argmax_prefer_high(gain_nat)
+        best_gain_f = jnp.take_along_axis(gain_nat, t_idx[:, None], axis=1)[:, 0]
+        best_thr_f = t_idx.astype(jnp.int32)
+        best_dbz_f = db.astype(jnp.int32)
+        best_left_f = jnp.take_along_axis(base, t_idx[:, None, None], axis=1)[:, 0, :]
 
-    # per-feature best among numerical placements, honoring scan-order ties
-    best_gain_f = jnp.full((f,), NEG_INF)
-    best_thr_f = jnp.zeros((f,), jnp.int32)
-    best_dbz_f = jnp.zeros((f,), jnp.int32)
-    best_left_f = jnp.zeros((f, 3))
-    for gain_p, left_p, dbz_p, prefer_high in placements:
-        t_idx = _argmax_prefer_high(gain_p) if prefer_high else jnp.argmax(gain_p, axis=1)
-        g_p = jnp.take_along_axis(gain_p, t_idx[:, None], axis=1)[:, 0]
-        l_p = jnp.take_along_axis(left_p, t_idx[:, None, None], axis=1)[:, 0, :]
-        better = g_p > best_gain_f  # strict: earlier placement wins ties
-        best_thr_f = jnp.where(better, t_idx.astype(jnp.int32), best_thr_f)
-        best_dbz_f = jnp.where(better, jnp.broadcast_to(dbz_p, (f,)).astype(jnp.int32), best_dbz_f)
-        best_left_f = jnp.where(better[:, None], l_p, best_left_f)
-        best_gain_f = jnp.where(better, g_p, best_gain_f)
+    if not has_categorical:
+        best_gain_f = jnp.where(feature_mask > 0, best_gain_f, NEG_INF)
+        best_gain_f = jnp.where(
+            jnp.isfinite(best_gain_f), best_gain_f - min_gain_shift, NEG_INF
+        )
+        return best_gain_f, best_thr_f, best_dbz_f, best_left_f
 
     # categorical one-vs-rest (FindBestThresholdCategorical, hpp:100-198):
     # left = exactly bin t, decision type "is"; zeros keep their natural bin
@@ -275,3 +287,4 @@ def best_split_all_features(
         hist, sum_g, sum_h, num_data, meta, hyper, feature_mask, use_missing
     )
     return finalize_split(gain_f, thr_f, dbz_f, left_f, sum_g, sum_h, num_data, hyper)
+
