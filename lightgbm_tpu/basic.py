@@ -83,6 +83,19 @@ class Dataset:
             {k: v for k, v in self.params.items() if k != "categorical_feature"}
         )
         if self.data is None and self.data_path is not None:
+            # binary dataset cache first (DatasetLoader::LoadFromBinFile)
+            if BinnedDataset.is_binary_cache(self.data_path):
+                ds = BinnedDataset.load_binary(self.data_path)
+                if self.label is not None:
+                    ds.metadata.set_label(self.label)
+                if self.weight is not None:
+                    ds.metadata.set_weights(self.weight)
+                if self.group is not None:
+                    ds.metadata.set_query(self.group)
+                if self.init_score is not None:
+                    ds.metadata.set_init_score(self.init_score)
+                self._constructed = ds
+                return ds
             from .io.parser import load_text_file
 
             feats, label, weights, group, names, label_idx = load_text_file(

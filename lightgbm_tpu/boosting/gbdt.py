@@ -137,7 +137,8 @@ class GBDT:
 
             if _pt_eligible(config, train_set, objective, self.num_tree_per_iteration):
                 self.ptrainer = PartitionedTrainer(
-                    train_set, config, objective, self.meta, self.hyper
+                    train_set, config, objective, self.meta, self.hyper,
+                    bins_dev=self.bins,
                 )
                 Log.info("Using partitioned (fused) TPU tree learner")
         k = self.num_tree_per_iteration

@@ -41,7 +41,7 @@ from ..ops.pgrow import (
     grow_tree_partitioned,
     segment_values,
 )
-from ..ops.pkernels import PLayout, pack_matrix
+from ..ops.pkernels import PLayout, pack_matrix_device
 from ..ops.split import FeatureMeta, SplitHyper
 from ..utils.log import Log
 
@@ -57,15 +57,18 @@ def _i2f(x):
 class PartitionedTrainer:
     """Owns the packed matrix + fused train-chunk programs for one GBDT."""
 
-    def __init__(self, train_set, config, objective, meta: FeatureMeta, hyper: SplitHyper):
-        binned = np.asarray(train_set.binned)
+    def __init__(self, train_set, config, objective, meta: FeatureMeta, hyper: SplitHyper,
+                 bins_dev=None):
+        binned = train_set.binned
         n, f = binned.shape
         assert binned.dtype == np.uint8
         md = train_set.metadata
         self.has_weights = md.weights is not None
         self.layout = PLayout(f, num_score=1, with_weight=True)
-        self.p = pack_matrix(binned, self.layout, label=md.label,
-                             weight=md.weights if self.has_weights else None)
+        if bins_dev is None:
+            bins_dev = jnp.asarray(np.asarray(binned))
+        self.p = pack_matrix_device(bins_dev, self.layout, label=md.label,
+                                    weight=md.weights if self.has_weights else None)
         self.scratch = jnp.zeros_like(self.p)
         self.num_rows = n
         self.meta = meta

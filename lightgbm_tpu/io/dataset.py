@@ -270,7 +270,24 @@ class BinnedDataset:
             )
             payload[f"m{i}_bounds"] = st["bin_upper_bound"]
             payload[f"m{i}_cats"] = st["bin_2_categorical"]
-        np.savez_compressed(path, **payload)
+        # write to the EXACT path (np.savez appends .npz to bare names;
+        # the reference's SaveBinaryFile writes the filename it was given)
+        with open(path, "wb") as f:
+            np.savez_compressed(f, **payload)
+
+    @staticmethod
+    def is_binary_cache(path: str) -> bool:
+        """True when ``path`` is a saved binary dataset (zip magic +
+        our payload) — DatasetLoader checks the binary header before
+        falling back to text parsing (dataset_loader.cpp LoadFromBinFile)."""
+        try:
+            with open(path, "rb") as f:
+                if f.read(4) != b"PK\x03\x04":
+                    return False
+            with np.load(path, allow_pickle=False) as z:
+                return "magic" in z and str(z["magic"]) == _BINARY_MAGIC
+        except Exception:
+            return False
 
     @classmethod
     def load_binary(cls, path: str) -> "BinnedDataset":

@@ -113,6 +113,37 @@ def pack_matrix(bins: np.ndarray, layout: PLayout, label=None, weight=None) -> j
     return jnp.asarray(P)
 
 
+def pack_matrix_device(bins_dev, layout: PLayout, label=None, weight=None) -> jnp.ndarray:
+    """pack_matrix built ON DEVICE from an already-transferred (N, F)
+    uint8 bins array.  Host->device bandwidth through the tunneled TPU is
+    ~10 MB/s, so shipping the 28 B/row bins once and deriving the packed
+    matrix with XLA shifts beats shipping the 64 B/row matrix."""
+    n, f = bins_dev.shape
+    w = layout.W
+    pad_f = w * 4 - f
+    bb = jnp.pad(bins_dev.astype(jnp.int32), ((0, 0), (0, pad_f)))
+    bb = bb.reshape(n, w, 4)
+    shifts = (jnp.arange(4, dtype=jnp.int32) * 8)[None, None, :]
+    words = jnp.sum(bb << shifts, axis=2, dtype=jnp.int32)  # (N, W)
+    one = np.float32(1.0).view(np.int32)
+
+    def frow(x):
+        return jax.lax.bitcast_convert_type(jnp.asarray(x, jnp.float32), jnp.int32)
+
+    rows = [words.T]
+    rows.append(jnp.zeros((2, n), jnp.int32))  # g, h
+    rows.append(jnp.full((1, n), one, jnp.int32))  # sel
+    rows.append(jnp.zeros((layout.num_score, n), jnp.int32))  # scores
+    rows.append(frow(label if label is not None else np.zeros(n, np.float32))[None, :])
+    rows.append(jnp.arange(n, dtype=jnp.int32)[None, :])  # rowid
+    if layout.with_weight:
+        wv = jnp.ones((n,), jnp.float32) if weight is None else jnp.asarray(weight, jnp.float32)
+        rows.append(jax.lax.bitcast_convert_type(wv, jnp.int32)[None, :])
+    p = jnp.concatenate(rows, axis=0)
+    cpad = layout.C - p.shape[0]
+    return jnp.pad(p, ((0, cpad), (0, BLK)))
+
+
 def _tri_np() -> np.ndarray:
     """(BLK, BLK) upper-triangular ones: dot(v, tri)[d] = cumsum_{s<=d} v[s]."""
     i = np.arange(BLK)

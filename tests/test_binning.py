@@ -136,3 +136,25 @@ def test_config_aliases_and_unknown():
 def test_config_canonical_priority():
     cfg = Config.from_params({"num_iterations": 7, "num_boost_round": 9})
     assert cfg.num_iterations == 7
+
+
+def test_binary_cache_exact_filename_and_dataset_dispatch(tmp_path):
+    """save_binary writes the EXACT filename given (the reference's
+    SaveBinaryFile does), and lgb.Dataset(path) detects the cache and
+    skips text parsing."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((300, 4)).astype(np.float32)
+    y = rng.standard_normal(300).astype(np.float32)
+    p = str(tmp_path / "cache.bin")  # no .npz suffix
+    lgb.Dataset(X, label=y).save_binary(p)
+    import os
+    assert os.path.exists(p)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7, "verbose": -1},
+                    lgb.Dataset(p), 3)
+    assert bst.boosting.num_trees >= 3
+    # explicit group / init_score supplied alongside a cache path are honored
+    ds3 = lgb.Dataset(p, group=[150, 150], init_score=np.zeros(300)).construct()
+    assert ds3.metadata.query_boundaries is not None
+    assert ds3.metadata.init_score is not None
