@@ -609,6 +609,40 @@ class GBDT:
             out = np.stack([t.predict_leaf_index(np.asarray(data, np.float64))
                             for t in models], axis=1)
             return out
+        if self.config is not None and getattr(self.config, "pred_early_stop", False):
+            # margin-based per-row early exit over trees
+            # (CreatePredictionEarlyStopInstance, prediction_early_stop.cpp:74-89;
+            # Predictor ctor wiring, application/predictor.hpp:24-120)
+            from .pred_early_stop import (
+                create_prediction_early_stop_instance,
+                predict_with_early_stop,
+            )
+
+            # binary margin only applies to sigmoid-type objectives; the
+            # reference keeps "none" (never stop) otherwise (predictor.hpp)
+            if self.num_tree_per_iteration > 1:
+                es_type = "multiclass"
+            elif self.objective is not None and self.objective.name == "binary":
+                es_type = "binary"
+            else:
+                es_type = "none"
+            inst = create_prediction_early_stop_instance(
+                es_type,
+                int(self.config.pred_early_stop_freq),
+                float(self.config.pred_early_stop_margin),
+            )
+            raw = predict_with_early_stop(
+                self, np.asarray(data, np.float64), inst, num_iteration
+            ).T  # (K, N)
+            if raw_score:
+                return raw[0] if raw.shape[0] == 1 else raw.T
+            if self.objective is not None:
+                conv = np.asarray(
+                    self.objective.convert_output(jnp.asarray(raw)), np.float64
+                )
+            else:
+                conv = raw
+            return conv[0] if conv.shape[0] == 1 else conv.T
         raw = self.predict_raw_scores(data, num_iteration)
         if raw_score:
             return raw[0] if raw.shape[0] == 1 else raw.T

@@ -54,12 +54,13 @@ def create_prediction_early_stop_instance(
 
 
 def predict_with_early_stop(
-    boosting, data: np.ndarray, early_stop: PredictionEarlyStopInstance
+    boosting, data: np.ndarray, early_stop: PredictionEarlyStopInstance,
+    num_iteration: int = -1,
 ) -> np.ndarray:
     """Row-at-a-time raw prediction with the margin exit
     (GBDT::PredictRaw + early stop, gbdt_prediction.cpp)."""
     k = boosting.num_tree_per_iteration
-    models = boosting.models
+    models = boosting._used_models(num_iteration)
     n = data.shape[0]
     out = np.zeros((n, k))
     for r in range(n):

@@ -174,3 +174,32 @@ def test_libsvm_loading(tmp_path):
     X, y, w, g, names, li = load_text_file(str(p), Config())
     np.testing.assert_array_equal(y, [1, 0])
     np.testing.assert_allclose(X, [[1.5, 0, 2.5], [0, 3.5, 0]])
+
+
+def test_pred_early_stop_wired_into_predict():
+    """pred_early_stop config keys drive Booster.predict: early-stopped
+    predictions match full predictions for high-margin rows and the keys
+    are no longer dead (predictor.hpp:24-120)."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((800, 6)).astype(np.float32)
+    w = rng.standard_normal(6) * 3.0
+    y = ((X @ w) > 0).astype(np.float32)  # separable -> large margins
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1},
+                    lgb.Dataset(X, label=y), 30)
+    full = bst.predict(X[:50])
+    bst.boosting.config.pred_early_stop = True
+    bst.boosting.config.pred_early_stop_freq = 5
+    bst.boosting.config.pred_early_stop_margin = 10.0
+    es = bst.predict(X[:50])
+    # high-margin rows: sign/class decisions identical, values close for
+    # confident rows (stop only fires beyond the margin)
+    assert np.array_equal(full > 0.5, es > 0.5)
+    conf = np.abs(full - 0.5) > 0.45
+    assert conf.any()
+    np.testing.assert_allclose(es[conf], full[conf], atol=2e-2)
+    # huge margin threshold => never stops => exactly equal
+    bst.boosting.config.pred_early_stop_margin = 1e9
+    never = bst.predict(X[:50])
+    np.testing.assert_allclose(never, full, rtol=1e-6, atol=1e-7)
