@@ -25,6 +25,11 @@ def _enable_compile_cache():
     flag = os.environ.get("LIGHTGBM_TPU_COMPILE_CACHE", "")
     if flag == "0":
         return
+    # CPU compiles may be served by a remote compile helper with different
+    # machine features; loading such AOT results risks SIGILL.  Cache only
+    # the (expensive, feature-stable) TPU programs unless explicitly asked.
+    if not flag and "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        return
     repo_root = os.path.dirname(os.path.dirname(__file__))
     if flag:
         path = flag

@@ -75,6 +75,13 @@ class GBDT:
         if objective is not None:
             objective.init(train_set.metadata, self.num_data)
 
+        # multi-host bootstrap must precede ANY device use (a backend
+        # query locks in a single-process runtime)
+        if config.tree_learner.lower() in ("data", "feature", "voting"):
+            from ..parallel.distributed import ensure_initialized
+
+            ensure_initialized(config)
+
         # device-resident training state
         self.bins = jnp.asarray(train_set.binned)
         self.num_bins = int(train_set.max_num_bin)

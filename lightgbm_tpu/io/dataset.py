@@ -178,7 +178,7 @@ class BinnedDataset:
             ds.max_bin = reference.max_bin
         else:
             cat_set = set(int(c) for c in categorical_features) if categorical_features else set()
-            mappers = _find_bin_mappers(data, config, cat_set, sample_indices)
+            mappers = _find_bin_mappers_distributed(data, config, cat_set, sample_indices)
             used = [i for i, m in enumerate(mappers) if not m.is_trivial]
             if not used:
                 Log.fatal("Cannot construct Dataset: all features are trivial (constant)")
@@ -330,6 +330,63 @@ class BinnedDataset:
 
 
 # ----------------------------------------------------------------------
+def _find_bin_mappers_distributed(
+    data: np.ndarray,
+    config: Config,
+    categorical: set,
+    sample_indices: Optional[np.ndarray],
+) -> List[BinMapper]:
+    """Distributed find-bin (dataset_loader.cpp:733-835): in a
+    multi-process runtime each process finds bins only for its contiguous
+    feature block [start_r, start_r + len_r) — step = ceil(F/M), exactly
+    the reference's assignment — then the serialized mappers are
+    allgathered so every process ends with the identical full list.  The
+    reference's max_bin Allreduce exists only to size its fixed-width
+    copy buffers; here the pickled states are length-prefixed instead.
+    Falls through to the single-process path otherwise."""
+    if not getattr(config, "is_parallel_find_bin", False):
+        return _find_bin_mappers(data, config, categorical, sample_indices)
+
+    import jax
+
+    from ..parallel.distributed import ensure_initialized
+
+    if not ensure_initialized(config):
+        return _find_bin_mappers(data, config, categorical, sample_indices)
+
+    import pickle
+
+    from jax.experimental import multihost_utils
+
+    nproc = jax.process_count()
+    rank = jax.process_index()
+    f_total = data.shape[1]
+    step = max(1, -(-f_total // nproc))
+    start = min(rank * step, f_total)
+    stop = min(start + step, f_total)
+
+    local_cats = {c - start for c in categorical if start <= c < stop}
+    if stop > start:
+        local = _find_bin_mappers(data[:, start:stop], config, local_cats, sample_indices)
+    else:
+        local = []
+    blobs = [pickle.dumps(m.state()) for m in local]
+    maxlen = max([len(b) for b in blobs], default=1)
+    gmax = int(np.max(multihost_utils.process_allgather(np.asarray(maxlen, np.int64))))
+    buf = np.zeros((step, gmax + 8), np.uint8)
+    for i, b in enumerate(blobs):
+        buf[i, :8] = np.frombuffer(len(b).to_bytes(8, "little"), np.uint8)
+        buf[i, 8 : 8 + len(b)] = np.frombuffer(b, np.uint8)
+    gathered = np.asarray(multihost_utils.process_allgather(buf))  # (M, step, gmax+8)
+    mappers: List[BinMapper] = []
+    for f in range(f_total):
+        r, i = divmod(f, step)
+        row = gathered[r, i]
+        ln = int.from_bytes(row[:8].tobytes(), "little")
+        mappers.append(BinMapper.from_state(pickle.loads(row[8 : 8 + ln].tobytes())))
+    return mappers
+
+
 def _find_bin_mappers(
     data: np.ndarray,
     config: Config,

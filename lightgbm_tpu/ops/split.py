@@ -31,7 +31,9 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
-NEG_INF = jnp.float32(-jnp.inf)
+# host constant: a jnp scalar here would initialize the XLA backend at
+# import time, which breaks jax.distributed.initialize (must run first)
+NEG_INF = float("-inf")
 
 
 class SplitHyper(NamedTuple):

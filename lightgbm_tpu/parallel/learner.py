@@ -107,17 +107,63 @@ class ShardedLearner:
             _shard_map_compat(body, mesh, in_specs, out_specs)
         )
         self._row_sharded = row_sharded
+        self._rep_consts = None  # cached replicated meta/hyper (multi-process)
 
     # ------------------------------------------------------------------
     def grow(self, bins, grad, hess, select, feature_mask, meta, hyper) -> GrowResult:
+        """Grow one tree.  In a multi-process runtime each process passes
+        its OWN row block (the reference's pre_partition=true contract,
+        config.h:116) with equal per-process row counts; arrays are
+        assembled into global row-sharded jax.Arrays and the collectives
+        inside the grower ride ICI/DCN."""
         n = bins.shape[0]
-        pad = (-n) % self.d if self._row_sharded else 0
+        multi = jax.process_count() > 1
+        shards = self.d if not multi else self.d // jax.process_count()
+        pad = (-n) % max(shards, 1) if self._row_sharded else 0
+        if multi and self._row_sharded:
+            # processes may hold unequal row shards; pad every process to
+            # the global max so the assembled global array is rectangular
+            from jax.experimental import multihost_utils
+
+            counts = np.asarray(multihost_utils.process_allgather(np.asarray(n)))
+            gmax = int(counts.max())
+            gmax += (-gmax) % max(shards, 1)
+            pad = gmax - n
         if pad:
             bins = jnp.pad(bins, ((0, pad), (0, 0)))
             grad = jnp.pad(grad, (0, pad))
             hess = jnp.pad(hess, (0, pad))
             select = jnp.pad(select, (0, pad))  # padded rows: select=0
+        if multi:
+            from .distributed import global_rows_array, replicated_array
+
+            if self._row_sharded:
+                bins = global_rows_array(bins, self.mesh)
+                grad = global_rows_array(grad, self.mesh)
+                hess = global_rows_array(hess, self.mesh)
+                select = global_rows_array(select, self.mesh)
+            else:
+                bins = replicated_array(bins, self.mesh)
+                grad = replicated_array(grad, self.mesh)
+                hess = replicated_array(hess, self.mesh)
+                select = replicated_array(select, self.mesh)
+            feature_mask = replicated_array(feature_mask, self.mesh)
+            # meta/hyper are loop-invariant: replicate once, not per tree
+            if self._rep_consts is None:
+                self._rep_consts = (
+                    jax.tree_util.tree_map(lambda x: replicated_array(x, self.mesh), meta),
+                    jax.tree_util.tree_map(lambda x: replicated_array(x, self.mesh), hyper),
+                )
+            meta, hyper = self._rep_consts
         gr = self._fn(bins, grad, hess, select, feature_mask, meta, hyper)
-        if pad:
+        if multi and self._row_sharded:
+            # leaf_id comes back row-sharded globally; hand the caller its
+            # process-local rows (matching the rows it passed in)
+            shards = sorted(
+                gr.leaf_id.addressable_shards, key=lambda s: s.index[0].start or 0
+            )
+            local = np.concatenate([np.asarray(s.data) for s in shards])
+            gr = gr._replace(leaf_id=jnp.asarray(local[:n]))
+        elif pad:
             gr = gr._replace(leaf_id=gr.leaf_id[:n])
         return gr

@@ -1,0 +1,108 @@
+"""Worker for the 2-process distributed parity test (run via subprocess).
+
+Each process: CPU platform with 4 virtual devices, rank from argv,
+jax.distributed over localhost.  Grows one data-parallel tree on its
+row half and (rank 0) writes the replicated split records to an npz.
+"""
+
+import os
+import sys
+
+rank = int(sys.argv[1])
+port = sys.argv[2]
+out = sys.argv[3]
+mode = sys.argv[4] if len(sys.argv) > 4 else "grow"
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+)
+os.environ["LIGHTGBM_TPU_COORDINATOR"] = f"127.0.0.1:{port}"
+os.environ["LIGHTGBM_TPU_NUM_PROCESSES"] = "2"
+os.environ["LIGHTGBM_TPU_PROCESS_ID"] = str(rank)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from lightgbm_tpu.parallel.distributed import ensure_initialized  # noqa: E402
+
+assert ensure_initialized() is True
+import jax  # noqa: E402
+
+# the axon TPU plugin ignores JAX_PLATFORMS; the config knob still wins
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp  # noqa: E402
+
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8
+
+from lightgbm_tpu.ops.grow import GrowParams  # noqa: E402
+from lightgbm_tpu.ops.split import FeatureMeta, SplitHyper  # noqa: E402
+from lightgbm_tpu.parallel import ShardedLearner, make_mesh  # noqa: E402
+
+if mode == "findbin":
+    # distributed find-bin parity: both ranks hold the SAME data; the
+    # feature mappers (each found by exactly one rank, then allgathered)
+    # must be bit-identical to the single-process mappers
+    import pickle
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+
+    rng = np.random.default_rng(9)
+    X = rng.standard_normal((5000, 13))
+    X[:, 3] = np.round(X[:, 3] * 2)  # low-cardinality column
+    y = rng.standard_normal(5000)
+    cfg_params = {"max_bin": 31, "tree_learner": "data", "num_machines": 2,
+                  "verbose": -1}
+    cfg = Config.from_params(dict(cfg_params))
+    assert cfg.is_parallel_find_bin, "expected parallel find-bin to engage"
+    ds = BinnedDataset.from_raw(X, cfg, label=y)
+    if rank == 0:
+        states = [m.state() for m in ds.bin_mappers]
+        with open(out, "wb") as fh:
+            pickle.dump({"states": states, "binned": ds.binned,
+                         "used": ds.used_feature_map}, fh)
+    print(f"rank {rank} findbin done: {len(ds.bin_mappers)} mappers")
+    sys.exit(0)
+
+# identical synthetic dataset on both ranks; each passes its own half
+rng = np.random.default_rng(42)
+N, F, B = 4096, 6, 16
+bins = rng.integers(0, B, size=(N, F), dtype=np.uint8)
+grad = rng.standard_normal(N).astype(np.float32)
+hess = np.abs(rng.standard_normal(N)).astype(np.float32) + 0.1
+# deliberately UNEQUAL shards: exercises the pad-to-global-max path
+cut = 2200
+sl = slice(0, cut) if rank == 0 else slice(cut, N)
+half = sl.stop - sl.start
+
+meta = FeatureMeta(
+    num_bins=jnp.full((F,), B, jnp.int32),
+    default_bin=jnp.zeros((F,), jnp.int32),
+    is_categorical=jnp.zeros((F,), bool),
+)
+hyper = SplitHyper(
+    lambda_l1=jnp.float32(0.0), lambda_l2=jnp.float32(0.01),
+    min_data_in_leaf=jnp.float32(20), min_sum_hessian_in_leaf=jnp.float32(1e-3),
+    min_gain_to_split=jnp.float32(0.0),
+)
+params = GrowParams(num_leaves=15, num_bins=B)
+learner = ShardedLearner("data", make_mesh(), params)
+gr = learner.grow(
+    jnp.asarray(bins[sl]), jnp.asarray(grad[sl]), jnp.asarray(hess[sl]),
+    jnp.ones((half,), jnp.float32), jnp.ones((F,), jnp.float32), meta, hyper,
+)
+ns = int(gr.num_splits)
+if rank == 0:
+    np.savez(
+        out,
+        num_splits=ns,
+        rec_feat=np.asarray(gr.rec_feat[:ns]),
+        rec_thr=np.asarray(gr.rec_thr[:ns]),
+        rec_leaf=np.asarray(gr.rec_leaf[:ns]),
+        rec_lval=np.asarray(gr.rec_lval[:ns]),
+        leaf_id_local=np.asarray(gr.leaf_id),
+    )
+print(f"rank {rank} done: {ns} splits")
