@@ -27,9 +27,18 @@ def _enable_compile_cache():
         return
     # CPU compiles may be served by a remote compile helper with different
     # machine features; loading such AOT results risks SIGILL.  Cache only
-    # the (expensive, feature-stable) TPU programs unless explicitly asked.
-    if not flag and "cpu" in os.environ.get("JAX_PLATFORMS", ""):
-        return
+    # the (expensive, feature-stable) TPU programs unless explicitly asked:
+    # skip when the run is CPU-bound (env forces cpu, or no TPU plugin is
+    # even importable — checked without touching the backend).
+    if not flag:
+        if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+            return
+        import importlib.util
+
+        if importlib.util.find_spec("libtpu") is None and importlib.util.find_spec(
+            "jax_plugins"
+        ) is None:
+            return
     repo_root = os.path.dirname(os.path.dirname(__file__))
     if flag:
         path = flag

@@ -42,7 +42,7 @@ class Dataset:
         self,
         data,
         label=None,
-        max_bin: int = 255,
+        max_bin: Optional[int] = None,
         reference: Optional["Dataset"] = None,
         weight=None,
         group=None,
@@ -67,7 +67,10 @@ class Dataset:
         self.group = group
         self.init_score = init_score
         self.params = dict(params) if params else {}
-        self.params.setdefault("max_bin", max_bin)
+        # only an EXPLICIT max_bin argument becomes a dataset param —
+        # otherwise booster params may fill it at Booster construction
+        if max_bin is not None:
+            self.params.setdefault("max_bin", max_bin)
         self.feature_name = feature_name
         self.categorical_feature = categorical_feature
         self.free_raw_data = free_raw_data
@@ -257,6 +260,12 @@ class Booster:
 
         if train_set is not None:
             self.config = Config.from_params(self.params)
+            # dataset-relevant train params reach construction unless the
+            # Dataset set them explicitly (Dataset._update_params: the
+            # dataset's own params win, booster params fill the gaps)
+            if train_set._constructed is None:
+                for k, v in self.params.items():
+                    train_set.params.setdefault(k, v)
             binned = train_set.construct()
             self.train_dataset = train_set
             self.objective = create_objective(self.config)

@@ -72,15 +72,16 @@ class GBDT:
         self.training_metrics = list(training_metrics)
         self.shrinkage_rate = config.learning_rate
 
-        if objective is not None:
-            objective.init(train_set.metadata, self.num_data)
-
         # multi-host bootstrap must precede ANY device use (a backend
-        # query locks in a single-process runtime)
+        # query locks in a single-process runtime) — including the
+        # objective's label transfer below
         if config.tree_learner.lower() in ("data", "feature", "voting"):
             from ..parallel.distributed import ensure_initialized
 
             ensure_initialized(config)
+
+        if objective is not None:
+            objective.init(train_set.metadata, self.num_data)
 
         # device-resident training state
         self.bins = jnp.asarray(train_set.binned)

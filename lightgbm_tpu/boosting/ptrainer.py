@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.pgrow import (
+    BundleMeta,
     PGrowParams,
     grow_tree_partitioned,
     segment_values,
@@ -64,9 +65,23 @@ class PartitionedTrainer:
         assert binned.dtype == np.uint8
         md = train_set.metadata
         self.has_weights = md.weights is not None
-        self.layout = PLayout(f, num_score=1, with_weight=True)
+        # EFB: stream the bundled (N, G) matrix instead of (N, F) when the
+        # dataset found exclusive bundles (io/bundle.py); split search and
+        # the model stay in real-feature space via BundleMeta
+        bundle = getattr(train_set, "bundle", None)
+        self.bmeta = None
+        num_cols, num_bins_hist = 0, 0
+        if bundle is not None and train_set.bundled is not None:
+            matrix = train_set.bundled
+            num_cols = bundle.num_cols
+            num_bins_hist = int(bundle.max_col_bin)
+            self.bmeta = _build_bundle_meta(bundle, train_set, int(train_set.max_num_bin))
+            bins_dev = None  # the unbundled device matrix is not what we pack
+        else:
+            matrix = binned
+        self.layout = PLayout(matrix.shape[1], num_score=1, with_weight=True)
         if bins_dev is None:
-            bins_dev = jnp.asarray(np.asarray(binned))
+            bins_dev = jnp.asarray(np.asarray(matrix))
         self.p = pack_matrix_device(bins_dev, self.layout, label=md.label,
                                     weight=md.weights if self.has_weights else None)
         self.scratch = jnp.zeros_like(self.p)
@@ -83,6 +98,8 @@ class PartitionedTrainer:
             max_depth=int(config.max_depth),
             use_missing=bool(config.use_missing),
             has_categorical=bool(np.any(np.asarray(meta.is_categorical))),
+            num_cols=num_cols,
+            num_bins_hist=num_bins_hist,
         )
         self.interpret = jax.default_backend() != "tpu"
         # start dirty: init_score / init_model may mutate GBDT.scores after
@@ -144,6 +161,7 @@ class PartitionedTrainer:
         params = self.params
         meta = self.meta
         hyper = self.hyper
+        bmeta = self.bmeta
         interpret = self.interpret
         bag_frac = float(self.config.bagging_fraction)
 
@@ -187,7 +205,8 @@ class PartitionedTrainer:
                     fmask = jnp.ones((F,), jnp.float32)
 
                 tree, p, scratch = grow_tree_partitioned(
-                    p, scratch, fmask, meta, hyper, params, interpret=interpret
+                    p, scratch, fmask, meta, hyper, params, bmeta=bmeta,
+                    interpret=interpret,
                 )
 
                 # score update: +lr * leaf_value over each segment.  Once
@@ -343,4 +362,46 @@ def eligible(config, train_set, objective, num_tree_per_iteration: int) -> bool:
         return False
     if train_set.max_num_bin > 256:
         return False
+    # bundling is built lazily, only once a partitioned run is plausible
+    if hasattr(train_set, "ensure_bundles"):
+        train_set.ensure_bundles(config)
+    # the histogram kernel unrolls per-column one-hot builds; very wide
+    # unbundled matrices blow up the Mosaic program (EFB normally keeps
+    # G small — beyond this, the mask-based grower handles it)
+    bundle = getattr(train_set, "bundle", None)
+    cols = bundle.num_cols if bundle is not None else train_set.num_features
+    if cols > 512:
+        return False
     return True
+
+
+def _build_bundle_meta(bundle, train_set, num_bins: int) -> BundleMeta:
+    """Host-built device maps for the bundled histogram expansion."""
+    f = train_set.num_features
+    b = num_bins
+    bh = int(bundle.max_col_bin)
+    default_bin = np.asarray([m.default_bin for m in train_set.bin_mappers], np.int64)
+    nb = np.asarray([m.num_bin for m in train_set.bin_mappers], np.int64)
+    zero_slot = bundle.num_cols * bh  # appended all-zero row
+    idx = np.full((f, b), zero_slot, np.int32)
+    defmask = np.zeros((f, b), bool)
+    for fe in range(f):
+        if int(bundle.off_lo[fe]) == 0:
+            # singleton raw column: every bin (incl. default) maps direct
+            for bi in range(int(nb[fe])):
+                idx[fe, bi] = int(bundle.col[fe]) * bh + bi
+            continue
+        for bi in range(int(nb[fe])):
+            if bi == int(default_bin[fe]):
+                defmask[fe, bi] = True
+                continue
+            v = int(bundle.off_lo[fe]) + bi - int(bundle.bias[fe])
+            idx[fe, bi] = int(bundle.col[fe]) * bh + v
+    return BundleMeta(
+        col=jnp.asarray(bundle.col),
+        off_lo=jnp.asarray(bundle.off_lo),
+        off_hi=jnp.asarray(bundle.off_hi),
+        bias=jnp.asarray(bundle.bias),
+        idx=jnp.asarray(idx),
+        defmask=jnp.asarray(defmask),
+    )

@@ -103,6 +103,8 @@ class BinnedDataset:
         self.feature_names: List[str] = []
         self.max_bin: int = 255
         self.label_idx: int = 0
+        self.bundle = None  # EFB BundleInfo (io/bundle.py); None = unbundled
+        self.bundled: Optional[np.ndarray] = None  # (N, G) uint8 bundle bins
         # raw (unbinned) copy is not kept — predictions on training data run
         # on the binned representation like the reference's score updater.
 
@@ -187,6 +189,23 @@ class BinnedDataset:
 
         ds.binned = _bin_matrix(data, ds.bin_mappers, ds.used_feature_map)
         return ds
+
+    def ensure_bundles(self, config) -> None:
+        """Lazily build EFB bundles (io/bundle.py).  Deferred out of
+        construction because only the partitioned trainer consumes them —
+        CPU runs, ranking, multiclass and distributed configs should not
+        pay the grouping scan or hold the extra (N, G) matrix."""
+        if self.bundle is not None or getattr(self, "_bundle_checked", False):
+            return
+        self._bundle_checked = True
+        if not getattr(config, "enable_bundle", True) or self.binned.dtype != np.uint8:
+            return
+        from .bundle import build_bundled_matrix, find_bundles
+
+        info = find_bundles(self.binned, self.bin_mappers, config)
+        if info is not None:
+            self.bundle = info
+            self.bundled = build_bundled_matrix(self.binned, self.bin_mappers, info)
 
     def create_valid(self, data, **kwargs) -> "BinnedDataset":
         """Validation dataset aligned with this dataset's bin mappers

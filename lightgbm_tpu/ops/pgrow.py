@@ -42,12 +42,42 @@ class PGrowParams(NamedTuple):
     """Static (compile-time) parameters of the partitioned grower."""
 
     num_leaves: int
-    num_bins: int  # padded B (<= 256)
+    num_bins: int  # padded per-feature B (<= 256)
     num_features: int
     num_rows: int  # real data rows (P has BLK tail padding)
     max_depth: int = -1
     use_missing: bool = True
     has_categorical: bool = True  # static: skips the categorical split scan
+    # EFB: physical matrix columns / histogram bins per column.  0 means
+    # unbundled (columns == features, bins == num_bins).
+    num_cols: int = 0
+    num_bins_hist: int = 0
+
+
+class BundleMeta(NamedTuple):
+    """Device-side EFB maps (io/bundle.py BundleInfo, shipped once).
+
+    idx maps (feature, feature-bin) -> flat bundle-histogram slot, with
+    default/padding bins pointing at the appended zero slot; the default
+    bin's mass is reconstructed as leaf_totals - non-default sums
+    (exactly the reference's bias/zero-bin subtraction in
+    FeatureHistogram::FindBestThreshold)."""
+
+    col: jnp.ndarray  # (F,) int32 bundle column per feature
+    off_lo: jnp.ndarray  # (F,) int32
+    off_hi: jnp.ndarray  # (F,) int32
+    bias: jnp.ndarray  # (F,) int32
+    idx: jnp.ndarray  # (F, B) int32 into (G*BH [+1 zero slot], 3)
+    defmask: jnp.ndarray  # (F, B) bool
+
+
+def _expand_bundle_hist(hist_g, sums, bmeta: BundleMeta, f: int, b: int):
+    """(G, BH, 3) bundle histogram -> (F, B, 3) per-feature histograms."""
+    flat = jnp.concatenate([hist_g.reshape(-1, 3), jnp.zeros((1, 3))], axis=0)
+    hf = flat[bmeta.idx.reshape(-1)].reshape(f, b, 3)
+    nd_sums = jnp.sum(hf, axis=1)  # (F, 3): non-default mass
+    dfl = sums[None, :] - nd_sums
+    return jnp.where(bmeta.defmask[:, :, None], dfl[:, None, :], hf)
 
 
 class PTreeResult(NamedTuple):
@@ -121,6 +151,7 @@ def grow_tree_partitioned(
     meta: FeatureMeta,
     hyper: SplitHyper,
     params: PGrowParams,
+    bmeta: BundleMeta = None,
     interpret: bool = False,
 ):
     """Grow one leaf-wise tree over the partitioned matrix.
@@ -133,9 +164,15 @@ def grow_tree_partitioned(
     F = params.num_features
     B = params.num_bins
     n = params.num_rows
+    # physical columns the kernels stream (EFB bundles or plain features)
+    G = params.num_cols or F
+    BH = params.num_bins_hist or B
+    bundled = bmeta is not None
 
     def find_best(hist, sums, depth_ok):
         sg, sh, sc = sums[0], sums[1], sums[2]
+        if bundled:
+            hist = _expand_bundle_hist(hist, sums, bmeta, F, B)
         gain_f, thr_f, dbz_f, left_f = best_split_per_feature(
             hist, sg, sh, sc, meta, hyper, feature_mask, params.use_missing,
             has_categorical=params.has_categorical,
@@ -143,7 +180,7 @@ def grow_tree_partitioned(
         res = finalize_split(gain_f, thr_f, dbz_f, left_f, sg, sh, sc, hyper)
         return res._replace(gain=jnp.where(depth_ok, res.gain, NEG_INF))
 
-    root_hist = hist_dyn(p, 0, n, F, B, interpret=interpret)
+    root_hist = hist_dyn(p, 0, n, G, BH, interpret=interpret)
     root_sums = jnp.sum(root_hist[0], axis=0)  # (3,): totals via feature 0
     root_res = find_best(root_hist, root_sums, jnp.array(True))
 
@@ -158,7 +195,7 @@ def grow_tree_partitioned(
         done=jnp.array(False),
         starts=zi,
         cnts=zi.at[0].set(n),
-        pool=jnp.zeros((L, F, B, 3)).at[0].set(root_hist),
+        pool=jnp.zeros((L, G, BH, 3)).at[0].set(root_hist),
         bs_gain=jnp.full((L,), NEG_INF),
         bs_feat=zi,
         bs_thr=zi,
@@ -196,10 +233,17 @@ def grow_tree_partitioned(
         cnt = st.cnts[bl]
         zb = meta.default_bin[feat]
         cat = meta.is_categorical[feat].astype(jnp.int32)
+        if bundled:
+            colidx = bmeta.col[feat]
+            off_lo, off_hi, bias = bmeta.off_lo[feat], bmeta.off_hi[feat], bmeta.bias[feat]
+        else:
+            colidx = feat
+            off_lo, off_hi, bias = jnp.int32(0), jnp.int32(256), jnp.int32(0)
 
         p, scratch, nl = partition_segment(
             st.p, st.scratch, start, cnt,
-            feat // 4, (feat % 4) * 8, zb, dbz, thr, cat,
+            colidx // 4, (colidx % 4) * 8, zb, dbz, thr, cat,
+            off_lo=off_lo, off_hi=off_hi, bias=bias,
             interpret=interpret,
         )
 
@@ -216,7 +260,7 @@ def grow_tree_partitioned(
         ils = nl < nr
         sm_start = jnp.where(ils, start, start + nl)
         sm_cnt = jnp.where(ils, nl, nr)
-        sm_hist = hist_dyn(p, sm_start, sm_cnt, F, B, interpret=interpret)
+        sm_hist = hist_dyn(p, sm_start, sm_cnt, G, BH, interpret=interpret)
         lg_hist = st.pool[bl] - sm_hist
         left_hist = jnp.where(ils, sm_hist, lg_hist)
         right_hist = jnp.where(ils, lg_hist, sm_hist)

@@ -325,6 +325,14 @@ def _part_kernel(
     dbz = sref[5]
     thr = sref[6]
     is_cat = sref[7]
+    # EFB bundle range remap (feature_group.h PushData layout): the
+    # feature's bins occupy stored values [off_lo, off_hi) with ``bias``
+    # correcting a dropped zero default bin; values outside the range
+    # mean "this feature at its default".  Unbundled features pass
+    # (0, 256, 0), making fb == raw value.
+    off_lo = sref[8]
+    off_hi = sref[9]
+    bias = sref[10]
     base = pl.multiple_of((start // BLK) * BLK, _LANE)
     head = start - base
     nblk = (head + cnt + BLK - 1) // BLK
@@ -359,7 +367,9 @@ def _part_kernel(
         valid = (pos >= head) & (pos < head + cnt)
         wordrow = jnp.sum(jnp.where(iota_c == word, blk, 0), axis=0, keepdims=True)
         binv = (wordrow >> shift) & 255
-        fv = jnp.where(binv == zero_bin, dbz, binv)
+        in_range = (binv >= off_lo) & (binv < off_hi)
+        fb = jnp.where(in_range, binv - off_lo + bias, zero_bin)
+        fv = jnp.where(fb == zero_bin, dbz, fb)
         eqv = (fv == thr).astype(jnp.int32)
         lev = (fv <= thr).astype(jnp.int32)
         gl = (jnp.where(is_cat == 1, eqv, lev) == 1) & valid
@@ -572,7 +582,8 @@ def _copyback_call(p, scratch, sv, interpret=False):
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def partition_segment(p, scratch, start, cnt, word, shift, zero_bin, dbz, thr, is_cat, interpret=False):
+def partition_segment(p, scratch, start, cnt, word, shift, zero_bin, dbz, thr, is_cat,
+                      off_lo=0, off_hi=256, bias=0, interpret=False):
     """Stable-partition the leaf segment [start, start+cnt) of ``p`` by
     the split predicate (DataPartition::Split, data_partition.hpp:94-150,
     fused with the DefaultValueForZero bin remap of dense_bin.hpp:191-232).
@@ -583,6 +594,7 @@ def partition_segment(p, scratch, start, cnt, word, shift, zero_bin, dbz, thr, i
         [
             jnp.int32(start), jnp.int32(cnt), jnp.int32(word), jnp.int32(shift),
             jnp.int32(zero_bin), jnp.int32(dbz), jnp.int32(thr), jnp.int32(is_cat),
+            jnp.int32(off_lo), jnp.int32(off_hi), jnp.int32(bias),
         ]
     )
     tri = _get_tri()

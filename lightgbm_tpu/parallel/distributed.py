@@ -90,11 +90,20 @@ def ensure_initialized(config=None, process_id: Optional[int] = None) -> bool:
                 lport = str(getattr(config, "local_listen_port", ""))
                 matches = [i for i, m in enumerate(machines) if m.split(":")[0] in local]
                 if len(matches) > 1:
-                    matches = [
+                    by_port = [
                         i for i in matches
                         if len(machines[i].split(":")) > 1
                         and machines[i].split(":")[1] == lport
-                    ] or matches[:1]
+                    ]
+                    if len(by_port) == 1:
+                        matches = by_port
+                    else:
+                        Log.fatal(
+                            "Cannot infer this process's rank: %d machine-list "
+                            "entries match the local host and local_listen_port "
+                            "does not disambiguate; set LIGHTGBM_TPU_PROCESS_ID",
+                            len(matches),
+                        )
                 if matches:
                     pid = matches[0]
     if not coord or not nproc or pid is None:

@@ -108,6 +108,7 @@ class ShardedLearner:
         )
         self._row_sharded = row_sharded
         self._rep_consts = None  # cached replicated meta/hyper (multi-process)
+        self._global_bins = None  # cached assembled bins + gmax (multi-process)
 
     # ------------------------------------------------------------------
     def grow(self, bins, grad, hess, select, feature_mask, meta, hyper) -> GrowResult:
@@ -123,12 +124,15 @@ class ShardedLearner:
         if multi and self._row_sharded:
             # processes may hold unequal row shards; pad every process to
             # the global max so the assembled global array is rectangular
-            from jax.experimental import multihost_utils
+            # (bins/row-count are immutable per learner — allgather once)
+            if self._global_bins is None:
+                from jax.experimental import multihost_utils
 
-            counts = np.asarray(multihost_utils.process_allgather(np.asarray(n)))
-            gmax = int(counts.max())
-            gmax += (-gmax) % max(shards, 1)
-            pad = gmax - n
+                counts = np.asarray(multihost_utils.process_allgather(np.asarray(n)))
+                gmax = int(counts.max())
+                gmax += (-gmax) % max(shards, 1)
+                self._gmax = gmax
+            pad = self._gmax - n
         if pad:
             bins = jnp.pad(bins, ((0, pad), (0, 0)))
             grad = jnp.pad(grad, (0, pad))
@@ -138,12 +142,16 @@ class ShardedLearner:
             from .distributed import global_rows_array, replicated_array
 
             if self._row_sharded:
-                bins = global_rows_array(bins, self.mesh)
+                if self._global_bins is None:
+                    self._global_bins = global_rows_array(bins, self.mesh)
+                bins = self._global_bins
                 grad = global_rows_array(grad, self.mesh)
                 hess = global_rows_array(hess, self.mesh)
                 select = global_rows_array(select, self.mesh)
             else:
-                bins = replicated_array(bins, self.mesh)
+                if self._global_bins is None:
+                    self._global_bins = replicated_array(bins, self.mesh)
+                bins = self._global_bins
                 grad = replicated_array(grad, self.mesh)
                 hess = replicated_array(hess, self.mesh)
                 select = replicated_array(select, self.mesh)

@@ -1,0 +1,126 @@
+"""EFB feature bundling (io/bundle.py ↔ dataset.cpp:64-208)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.bundle import (
+    build_bundled_matrix,
+    decode_bundled_column,
+    find_bundles,
+)
+from lightgbm_tpu.io.dataset import BinnedDataset
+
+
+def _sparse_exclusive(n=4000, blocks=50, per_block=8, seed=0):
+    """blocks*per_block one-hot-style features: inside a block exactly one
+    feature is non-zero per row — perfectly bundleable."""
+    rng = np.random.default_rng(seed)
+    f = blocks * per_block
+    X = np.zeros((n, f), np.float64)
+    signal = np.zeros(n)
+    for b in range(blocks):
+        which = rng.integers(0, per_block, n)
+        vals = rng.random(n) + 0.5
+        X[np.arange(n), b * per_block + which] = vals
+        signal += (which == 0) * vals
+    y = (signal + 0.3 * rng.standard_normal(n) > np.median(signal)).astype(np.float32)
+    return X, y
+
+
+class TestFindBundles:
+    def test_exclusive_features_bundle(self):
+        X, y = _sparse_exclusive()
+        cfg = Config.from_params({"max_bin": 15, "verbose": -1})
+        ds = BinnedDataset.from_raw(X, cfg, label=y)
+        ds.ensure_bundles(cfg)
+        assert ds.bundle is not None
+        info = ds.bundle
+        assert info.num_cols < ds.num_features / 3  # G << F
+        assert info.max_col_bin <= 256
+        # decode each feature's bins back from its bundle column — exact
+        # (zero conflicts by construction)
+        for fe in range(ds.num_features):
+            got = decode_bundled_column(
+                ds.bundled[:, info.col[fe]], fe, info,
+                ds.bin_mappers[fe].default_bin,
+            )
+            np.testing.assert_array_equal(got, ds.binned[:, fe].astype(np.int32))
+
+    def test_conflicting_features_stay_separate(self):
+        rng = np.random.default_rng(1)
+        X = rng.random((2000, 6)) + 0.5  # fully dense: every pair conflicts
+        cfg = Config.from_params({"max_bin": 15, "verbose": -1})
+        mappers_ds = BinnedDataset.from_raw(X, cfg, label=rng.random(2000))
+        mappers_ds.ensure_bundles(cfg)
+        assert mappers_ds.bundle is None  # G == F -> no bundling
+
+    def test_conflict_budget_allows_mild_overlap(self):
+        rng = np.random.default_rng(2)
+        n = 4000
+        X = np.zeros((n, 2))
+        X[: n // 2, 0] = rng.random(n // 2) + 0.5
+        X[n // 2 :, 1] = rng.random(n // 2) + 0.5
+        # 1% of rows conflict
+        k = n // 100
+        X[:k, 1] = rng.random(k) + 0.5
+        cfg0 = Config.from_params({"max_bin": 15, "max_conflict_rate": 0.0, "verbose": -1})
+        cfg5 = Config.from_params({"max_bin": 15, "max_conflict_rate": 0.05, "verbose": -1})
+        m = BinnedDataset.from_raw(X, cfg0, label=rng.random(n))
+        m.ensure_bundles(cfg0)
+        assert m.bundle is None  # zero budget: the 1% overlap blocks it
+        m5 = BinnedDataset.from_raw(X, cfg5, label=rng.random(n))
+        m5.ensure_bundles(cfg5)
+        assert m5.bundle is not None and m5.bundle.num_cols == 1
+
+
+class TestBundledTraining:
+    def test_prediction_parity_bundled_vs_unbundled(self, monkeypatch):
+        """Zero-conflict bundles must reproduce the unbundled model: same
+        histograms -> same trees -> same predictions."""
+        monkeypatch.setenv("LIGHTGBM_TPU_PGROW", "force")
+        X, y = _sparse_exclusive(n=3000, blocks=25, per_block=8)
+        params = dict(objective="binary", num_leaves=15, learning_rate=0.2,
+                      max_bin=15, min_data_in_leaf=20, verbose=-1)
+        preds = {}
+        trees = {}
+        for mode, extra in [("bundled", {}), ("plain", {"enable_bundle": False})]:
+            ds = lgb.Dataset(X, label=y, params=dict(params, **extra))
+            bst = lgb.train(dict(params, **extra), ds, num_boost_round=3)
+            if mode == "bundled":
+                assert ds.construct().bundle is not None  # built lazily by eligibility
+                assert bst.boosting.ptrainer.bmeta is not None
+            preds[mode] = bst.predict(X)
+            trees[mode] = bst.boosting.models[-1].to_string_lines() if hasattr(
+                bst.boosting.models[-1], "to_string_lines") else None
+        np.testing.assert_allclose(preds["bundled"], preds["plain"], rtol=3e-3, atol=3e-4)
+
+
+    def test_mixed_dense_sparse_singletons(self, monkeypatch):
+        """Dense features become raw-layout singleton columns next to real
+        bundles; training parity must hold across the mix."""
+        monkeypatch.setenv("LIGHTGBM_TPU_PGROW", "force")
+        rng = np.random.default_rng(7)
+        n = 3000
+        Xs, y = _sparse_exclusive(n=n, blocks=10, per_block=6, seed=7)
+        Xd = rng.standard_normal((n, 4))  # dense: forced singletons
+        X = np.concatenate([Xd, Xs], axis=1)
+        params = dict(objective="binary", num_leaves=15, learning_rate=0.2,
+                      max_bin=15, min_data_in_leaf=20, verbose=-1)
+        ds = lgb.Dataset(X, label=y, params=dict(params))
+        bst = lgb.train(params, ds, num_boost_round=3)
+        c = ds.construct()
+        assert c.bundle is not None
+        sizes = sorted(len(g) for g in c.bundle.groups)
+        assert sizes[0] == 1 and sizes[-1] > 1  # singletons AND bundles
+        # singleton raw columns decode identically
+        for g, feats in enumerate(c.bundle.groups):
+            if len(feats) == 1:
+                fe = feats[0]
+                got = decode_bundled_column(c.bundled[:, g], fe, c.bundle,
+                                            c.bin_mappers[fe].default_bin)
+                np.testing.assert_array_equal(got, c.binned[:, fe].astype(np.int32))
+        p2 = dict(params, enable_bundle=False)
+        bst2 = lgb.train(p2, lgb.Dataset(X, label=y, params=p2), num_boost_round=3)
+        np.testing.assert_allclose(bst.predict(X), bst2.predict(X), rtol=3e-3, atol=3e-4)
