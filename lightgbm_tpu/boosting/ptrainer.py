@@ -30,6 +30,7 @@ Deliberate parity divergences from the reference (documented):
 from __future__ import annotations
 
 import functools
+import os
 import types
 
 import jax
@@ -77,9 +78,20 @@ class PartitionedTrainer:
             num_bins_hist = int(bundle.max_col_bin)
             self.bmeta = _build_bundle_meta(bundle, train_set, int(train_set.max_num_bin))
             bins_dev = None  # the unbundled device matrix is not what we pack
+            max_col_bin = num_bins_hist
         else:
             matrix = binned
-        self.layout = PLayout(matrix.shape[1], num_score=1, with_weight=True)
+            max_col_bin = int(train_set.max_num_bin)
+        # 4-bit packed words when every column fits 16 bins
+        # (dense_nbits_bin.hpp:37): half the resident bin bytes/traffic
+        # (LIGHTGBM_TPU_FORCE_BITS=8 disables, e.g. for A/B measurement)
+        force_bits = os.environ.get("LIGHTGBM_TPU_FORCE_BITS", "")
+        bits = 4 if max_col_bin <= 16 else 8
+        if force_bits in ("4", "8"):
+            bits = int(force_bits)
+            if bits == 4 and max_col_bin > 16:
+                bits = 8  # cannot pack >16 bins in 4 bits
+        self.layout = PLayout(matrix.shape[1], num_score=1, with_weight=True, bits=bits)
         if bins_dev is None:
             bins_dev = jnp.asarray(np.asarray(matrix))
         self.p = pack_matrix_device(bins_dev, self.layout, label=md.label,
@@ -100,6 +112,7 @@ class PartitionedTrainer:
             has_categorical=bool(np.any(np.asarray(meta.is_categorical))),
             num_cols=num_cols,
             num_bins_hist=num_bins_hist,
+            bits=bits,
         )
         self.interpret = jax.default_backend() != "tpu"
         # start dirty: init_score / init_model may mutate GBDT.scores after
@@ -345,8 +358,6 @@ class PartitionedTrainer:
 def eligible(config, train_set, objective, num_tree_per_iteration: int) -> bool:
     """Can the partitioned trainer drive this configuration?  (The rest
     falls back to the mask-based grower, which handles everything.)"""
-    import os
-
     flag = os.environ.get("LIGHTGBM_TPU_PGROW", "")
     if flag == "0":
         return False

@@ -52,6 +52,9 @@ class PGrowParams(NamedTuple):
     # unbundled (columns == features, bins == num_bins).
     num_cols: int = 0
     num_bins_hist: int = 0
+    # bin word width: 4 (Dense4bitsBin form, 8 bins/word) when every
+    # column fits 16 bins, else 8
+    bits: int = 8
 
 
 class BundleMeta(NamedTuple):
@@ -180,7 +183,7 @@ def grow_tree_partitioned(
         res = finalize_split(gain_f, thr_f, dbz_f, left_f, sg, sh, sc, hyper)
         return res._replace(gain=jnp.where(depth_ok, res.gain, NEG_INF))
 
-    root_hist = hist_dyn(p, 0, n, G, BH, interpret=interpret)
+    root_hist = hist_dyn(p, 0, n, G, BH, bits=params.bits, interpret=interpret)
     root_sums = jnp.sum(root_hist[0], axis=0)  # (3,): totals via feature 0
     root_res = find_best(root_hist, root_sums, jnp.array(True))
 
@@ -240,11 +243,12 @@ def grow_tree_partitioned(
             colidx = feat
             off_lo, off_hi, bias = jnp.int32(0), jnp.int32(256), jnp.int32(0)
 
+        per = 32 // params.bits
         p, scratch, nl = partition_segment(
             st.p, st.scratch, start, cnt,
-            colidx // 4, (colidx % 4) * 8, zb, dbz, thr, cat,
+            colidx // per, (colidx % per) * params.bits, zb, dbz, thr, cat,
             off_lo=off_lo, off_hi=off_hi, bias=bias,
-            interpret=interpret,
+            bits=params.bits, interpret=interpret,
         )
 
         left = st.bs_left[bl]
@@ -260,7 +264,7 @@ def grow_tree_partitioned(
         ils = nl < nr
         sm_start = jnp.where(ils, start, start + nl)
         sm_cnt = jnp.where(ils, nl, nr)
-        sm_hist = hist_dyn(p, sm_start, sm_cnt, G, BH, interpret=interpret)
+        sm_hist = hist_dyn(p, sm_start, sm_cnt, G, BH, bits=params.bits, interpret=interpret)
         lg_hist = st.pool[bl] - sm_hist
         left_hist = jnp.where(ils, sm_hist, lg_hist)
         right_hist = jnp.where(ils, lg_hist, sm_hist)

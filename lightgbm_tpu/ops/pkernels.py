@@ -51,24 +51,33 @@ BLK = 1024  # columns (data rows) per streamed chunk
 _LANE = 128  # DMA lane-alignment quantum
 
 
-def num_words(num_features: int) -> int:
-    return -(-num_features // 4)
+def num_words(num_features: int, bits: int = 8) -> int:
+    return -(-num_features // (32 // bits))
 
 
-def num_channels(num_features: int, num_score: int = 1, with_weight: bool = True) -> int:
+def num_channels(num_features: int, num_score: int = 1, with_weight: bool = True,
+                 bits: int = 8) -> int:
     """Total padded channel count: W words + g,h,sel + num_score scores +
     label + rowid (+ weight), padded to a multiple of 8 (DMA sublane
     tiling)."""
-    c = num_words(num_features) + 3 + num_score + 2 + (1 if with_weight else 0)
+    c = num_words(num_features, bits) + 3 + num_score + 2 + (1 if with_weight else 0)
     return -(-c // 8) * 8
 
 
 class PLayout:
-    """Channel-row indices inside the packed matrix."""
+    """Channel-row indices inside the packed matrix.
 
-    def __init__(self, num_features: int, num_score: int = 1, with_weight: bool = True):
+    ``bits`` selects the bin word width: 8 (4 bins/int32) for max_bin up
+    to 256, or 4 (8 bins/int32) when every column fits 16 bins — the TPU
+    form of the reference's Dense4bitsBin (dense_nbits_bin.hpp:37),
+    halving resident bin bytes and per-row stream traffic."""
+
+    def __init__(self, num_features: int, num_score: int = 1, with_weight: bool = True,
+                 bits: int = 8):
         self.F = num_features
-        self.W = num_words(num_features)
+        self.bits = bits
+        self.per = 32 // bits
+        self.W = num_words(num_features, bits)
         self.G = self.W
         self.H = self.W + 1
         self.SEL = self.W + 2
@@ -78,7 +87,7 @@ class PLayout:
         self.ROWID = self.LABEL + 1
         self.WEIGHT = self.ROWID + 1 if with_weight else -1
         self.with_weight = with_weight
-        self.C = num_channels(num_features, num_score, with_weight)
+        self.C = num_channels(num_features, num_score, with_weight, bits)
 
 
 def pack_matrix(bins: np.ndarray, layout: PLayout, label=None, weight=None) -> jnp.ndarray:
@@ -90,16 +99,17 @@ def pack_matrix(bins: np.ndarray, layout: PLayout, label=None, weight=None) -> j
     n, f = bins.shape
     assert f == layout.F
     assert bins.dtype == np.uint8, "partitioned path requires max_bin <= 256"
-    w = layout.W
-    pad_f = w * 4 - f
+    assert int(bins.max(initial=0)) < (1 << layout.bits), (
+        f"bin values exceed the {layout.bits}-bit word field"
+    )
+    w, per, bits = layout.W, layout.per, layout.bits
+    pad_f = w * per - f
     bb = np.pad(np.asarray(bins), ((0, 0), (0, pad_f))).astype(np.uint32)
-    bb = bb.reshape(n, w, 4)
-    words = (
-        bb[:, :, 0]
-        | (bb[:, :, 1] << 8)
-        | (bb[:, :, 2] << 16)
-        | (bb[:, :, 3] << 24)
-    ).astype(np.uint32).view(np.int32)
+    bb = bb.reshape(n, w, per)
+    words = np.zeros((n, w), np.uint32)
+    for k in range(per):
+        words |= bb[:, :, k] << (bits * k)
+    words = words.view(np.int32)
     P = np.zeros((layout.C, n + BLK), np.int32)
     P[:w, :n] = words.T
     one = np.float32(1.0).view(np.int32)
@@ -119,11 +129,15 @@ def pack_matrix_device(bins_dev, layout: PLayout, label=None, weight=None) -> jn
     ~10 MB/s, so shipping the 28 B/row bins once and deriving the packed
     matrix with XLA shifts beats shipping the 64 B/row matrix."""
     n, f = bins_dev.shape
-    w = layout.W
-    pad_f = w * 4 - f
+    w, per, bits = layout.W, layout.per, layout.bits
+    pad_f = w * per - f
     bb = jnp.pad(bins_dev.astype(jnp.int32), ((0, 0), (0, pad_f)))
-    bb = bb.reshape(n, w, 4)
-    shifts = (jnp.arange(4, dtype=jnp.int32) * 8)[None, None, :]
+    # mask defensively: an oversized bin value would OR into the next
+    # feature's field (callers guarantee the bound; this keeps corruption
+    # local to the offending feature instead of silent cross-talk)
+    bb = bb & ((1 << bits) - 1)
+    bb = bb.reshape(n, w, per)
+    shifts = (jnp.arange(per, dtype=jnp.int32) * bits)[None, None, :]
     words = jnp.sum(bb << shifts, axis=2, dtype=jnp.int32)  # (N, W)
     one = np.float32(1.0).view(np.int32)
 
@@ -182,7 +196,7 @@ def _unplanes(dots_f32, c):
 # ======================================================================
 # histogram kernel
 # ======================================================================
-def _hist_kernel(sref, p_any, o_ref, acc_ref, buf_ref, sem, *, nf, nb, w, c, fchunk):
+def _hist_kernel(sref, p_any, o_ref, acc_ref, buf_ref, sem, *, nf, nb, w, c, fchunk, bits):
     start = sref[0]
     cnt = sref[1]
     base = pl.multiple_of((start // BLK) * BLK, _LANE)
@@ -228,12 +242,14 @@ def _hist_kernel(sref, p_any, o_ref, acc_ref, buf_ref, sem, *, nf, nb, w, c, fch
         h3 = split3(h)
         vals = jnp.concatenate(list(g3) + list(h3) + [sel.astype(jnp.bfloat16)], axis=0)
 
+        per = 32 // bits
+        mask = (1 << bits) - 1
         for c0 in range(0, nf, fchunk):
             c1 = min(c0 + fchunk, nf)
             chunks = []
             for f in range(c0, c1):
-                wd, p4 = divmod(f, 4)
-                byte = (blk[wd : wd + 1, :] >> (p4 * 8)) & 255
+                wd, p4 = divmod(f, per)
+                byte = (blk[wd : wd + 1, :] >> (p4 * bits)) & mask
                 chunks.append((byte == iota_b).astype(jnp.bfloat16))
             oh = jnp.concatenate(chunks, axis=0)
             # (7, BLK) x (F_c*B, BLK) -> (7, F_c*B): value rows on sublanes
@@ -249,17 +265,19 @@ def _hist_kernel(sref, p_any, o_ref, acc_ref, buf_ref, sem, *, nf, nb, w, c, fch
     o_ref[:, :] = acc_ref[:, :]
 
 
-@functools.partial(jax.jit, static_argnames=("num_features", "num_bins", "interpret"))
-def hist_dyn(p, start, cnt, num_features, num_bins, interpret=False):
+@functools.partial(jax.jit, static_argnames=("num_features", "num_bins", "bits", "interpret"))
+def hist_dyn(p, start, cnt, num_features, num_bins, bits=8, interpret=False):
     """(F, B, 3) histogram of the leaf segment [start, start+cnt) of the
     packed matrix ``p`` — DenseBin::ConstructHistogram (dense_bin.hpp:66)
-    over the leaf's contiguous rows, streamed at HBM bandwidth."""
-    w = num_words(num_features)
+    over the leaf's contiguous rows, streamed at HBM bandwidth.  bits=4
+    streams the Dense4bitsBin-packed form (8 bins per word)."""
+    w = num_words(num_features, bits)
     c = p.shape[0]
     fb = num_features * num_bins
     fchunk = max(1, min(num_features, 512 // num_bins))
     out = pl.pallas_call(
-        functools.partial(_hist_kernel, nf=num_features, nb=num_bins, w=w, c=c, fchunk=fchunk),
+        functools.partial(_hist_kernel, nf=num_features, nb=num_bins, w=w, c=c,
+                          fchunk=fchunk, bits=bits),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(1,),
@@ -315,7 +333,7 @@ def _stream_drain(stage, wsem, nstarts):
 
 def _part_kernel(
     sref, tri_ref, p_in, s_in, p_any, s_any, nl_ref,
-    buf, carL, carR, stageL, stageR, tmp, rsem, csem, wsemL, wsemR, *, c,
+    buf, carL, carR, stageL, stageR, tmp, rsem, csem, wsemL, wsemR, *, c, bits,
 ):
     start = sref[0]
     cnt = sref[1]
@@ -366,7 +384,7 @@ def _part_kernel(
         pos = lane + j * BLK
         valid = (pos >= head) & (pos < head + cnt)
         wordrow = jnp.sum(jnp.where(iota_c == word, blk, 0), axis=0, keepdims=True)
-        binv = (wordrow >> shift) & 255
+        binv = (wordrow >> shift) & ((1 << bits) - 1)
         in_range = (binv >= off_lo) & (binv < off_hi)
         fb = jnp.where(in_range, binv - off_lo + bias, zero_bin)
         fv = jnp.where(fb == zero_bin, dbz, fb)
@@ -446,11 +464,11 @@ def _part_kernel(
     nl_ref[0] = fl * BLK + cl - head
 
 
-def _partition_call(p, scratch, tri, sv, interpret=False):
+def _partition_call(p, scratch, tri, sv, bits=8, interpret=False):
     c = p.shape[0]
     nscr = scratch.shape[1]
     return pl.pallas_call(
-        functools.partial(_part_kernel, c=c),
+        functools.partial(_part_kernel, c=c, bits=bits),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(1,),
@@ -581,9 +599,9 @@ def _copyback_call(p, scratch, sv, interpret=False):
     )(sv, scratch, p)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("bits", "interpret"))
 def partition_segment(p, scratch, start, cnt, word, shift, zero_bin, dbz, thr, is_cat,
-                      off_lo=0, off_hi=256, bias=0, interpret=False):
+                      off_lo=0, off_hi=256, bias=0, bits=8, interpret=False):
     """Stable-partition the leaf segment [start, start+cnt) of ``p`` by
     the split predicate (DataPartition::Split, data_partition.hpp:94-150,
     fused with the DefaultValueForZero bin remap of dense_bin.hpp:191-232).
@@ -598,7 +616,7 @@ def partition_segment(p, scratch, start, cnt, word, shift, zero_bin, dbz, thr, i
         ]
     )
     tri = _get_tri()
-    p, scratch, nl = _partition_call(p, scratch, tri, sv, interpret=interpret)
+    p, scratch, nl = _partition_call(p, scratch, tri, sv, bits=bits, interpret=interpret)
     nl = nl[0]
     cntr = cnt - nl
     sv2 = jnp.stack([jnp.int32(start) + nl, cntr])
@@ -613,10 +631,11 @@ def unpack_bins(p, layout: PLayout, n: int) -> jnp.ndarray:
     """(N, F) uint8 bins recovered from the packed words (test helper)."""
     w = layout.W
     words = p[:w, :n]  # (W, N)
+    mask = (1 << layout.bits) - 1
     cols = []
     for f in range(layout.F):
-        wd, p4 = divmod(f, 4)
-        cols.append((words[wd] >> (p4 * 8)) & 255)
+        wd, p4 = divmod(f, layout.per)
+        cols.append((words[wd] >> (p4 * layout.bits)) & mask)
     return jnp.stack(cols, axis=1).astype(jnp.uint8)
 
 
@@ -637,8 +656,8 @@ def partition_ref(p, start: int, cnt: int, feat: int, zero_bin: int, dbz: int, t
     partition_segment."""
     pn = np.asarray(p)
     seg = pn[:, start : start + cnt]
-    wd, p4 = divmod(feat, 4)
-    binv = (seg[wd] >> (p4 * 8)) & 255
+    wd, p4 = divmod(feat, layout.per)
+    binv = (seg[wd] >> (p4 * layout.bits)) & ((1 << layout.bits) - 1)
     fv = np.where(binv == zero_bin, dbz, binv)
     gl = (fv == thr) if is_cat else (fv <= thr)
     out = np.concatenate([seg[:, gl], seg[:, ~gl]], axis=1)

@@ -126,6 +126,57 @@ class TestGrowParity:
         np.testing.assert_allclose(out, expect)
 
 
+class TestFourBitPacking:
+    """max_bin <= 16 -> 4-bit packed words (dense_nbits_bin.hpp:37):
+    half the bin rows, identical results."""
+
+    def test_kernel_parity_bits4(self):
+        rng = np.random.default_rng(11)
+        n, f, b = 5000, 11, 16
+        lay = pk.PLayout(f, bits=4)
+        assert lay.W == -(-f // 8)  # half the 8-bit word count
+        bins = rng.integers(0, b, size=(n, f), dtype=np.uint8)
+        P = pk.pack_matrix(bins, lay, label=rng.random(n).astype(np.float32))
+        g = rng.standard_normal(n).astype(np.float32)
+        h = np.abs(rng.standard_normal(n)).astype(np.float32)
+        P = P.at[lay.G, :n].set(jnp.asarray(g.view(np.int32)))
+        P = P.at[lay.H, :n].set(jnp.asarray(h.view(np.int32)))
+        hd = np.asarray(pk.hist_dyn(P, 123, 3000, f, b, bits=4, interpret=INTERP))
+        hr = np.asarray(pk.hist_ref(P, 123, 3000, lay, b))
+        err = np.abs(hd - hr).max() / max(np.abs(hr).max(), 1.0)
+        assert err < (2e-3 if INTERP else 1e-5)
+        scr = jnp.zeros_like(P)
+        feat = 5
+        P2, _, nl = pk.partition_segment(
+            P, scr, 100, 2000, feat // 8, (feat % 8) * 4, 0, 0, 7, 0,
+            bits=4, interpret=INTERP,
+        )
+        Pref, nlref = pk.partition_ref(P, 100, 2000, feat, 0, 0, 7, False, lay)
+        assert int(nl) == nlref
+        assert np.array_equal(np.asarray(P2), np.asarray(Pref))
+
+    def test_training_parity_bits4(self, monkeypatch):
+        import lightgbm_tpu as lgb
+
+        rng = np.random.default_rng(12)
+        X = rng.standard_normal((3000, 8)).astype(np.float32)
+        w = rng.standard_normal(8)
+        y = (rng.random(3000) < 1 / (1 + np.exp(-(X @ w)))).astype(np.float32)
+        params = dict(objective="binary", num_leaves=15, learning_rate=0.2,
+                      max_bin=15, min_data_in_leaf=20, verbose=-1,
+                      enable_bundle=False)
+        preds = {}
+        monkeypatch.delenv("LIGHTGBM_TPU_FORCE_BITS", raising=False)
+        for mode, env in [("pgrow4", "force"), ("default", "0")]:
+            monkeypatch.setenv("LIGHTGBM_TPU_PGROW", env)
+            bst = lgb.train(params, lgb.Dataset(X, label=y, params=dict(params)), 3)
+            if mode == "pgrow4":
+                assert bst.boosting.ptrainer.params.bits == 4
+                assert bst.boosting.ptrainer.layout.W == 1  # 8 feats, 1 word
+            preds[mode] = bst.predict(X)
+        np.testing.assert_allclose(preds["pgrow4"], preds["default"], rtol=3e-3, atol=3e-4)
+
+
 class TestFusedTrainer:
     def _data(self, n=3000, f=8, seed=0):
         rng = np.random.default_rng(seed)
