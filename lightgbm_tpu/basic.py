@@ -29,6 +29,16 @@ def _to_2d_float(data):
             return data.to_numpy(dtype=np.float64), [str(c) for c in data.columns]
     except ImportError:
         pass
+    # scipy CSR/CSC input (basic.py __init_from_csr/__init_from_csc):
+    # the TPU pipeline is dense by design (README sparse-bins decision) —
+    # densify here; EFB re-compacts exclusive columns downstream
+    if hasattr(data, "tocsr") and hasattr(data, "toarray"):
+        Log.warning(
+            "Sparse input is densified for the TPU pipeline "
+            "(%d x %d); EFB bundling recovers the memory on device",
+            *data.shape,
+        )
+        return np.asarray(data.toarray(), dtype=np.float64), None
     arr = np.asarray(data, dtype=np.float64)
     if arr.ndim == 1:
         arr = arr.reshape(-1, 1)
@@ -219,8 +229,8 @@ class Dataset:
         return self
 
     def subset(self, used_indices, params=None) -> "Dataset":
-        """Row-subset Dataset sharing this dataset's bin mappers
-        (basic.py Dataset.subset)."""
+        """Row-subset Dataset sharing this dataset's bin mappers and
+        BINNED rows (Dataset::CopySubset — no per-fold re-binning)."""
         used_indices = np.asarray(used_indices)
         sub = Dataset.__new__(Dataset)
         sub.data_path = None

@@ -106,6 +106,24 @@ def run_train(config: Config, params: Dict[str, str]) -> None:
     Log.info("Finished training, model saved to %s", config.output_model)
 
 
+def run_convert_model(config: Config, params: Dict[str, str]) -> None:
+    """task=convert_model (application.cpp:268-273): emit the standalone
+    C++ if-else predictor (convert_model.py <- GBDT::ModelToIfElse)."""
+    from .basic import Booster
+    from .convert_model import model_to_cpp
+
+    if not config.input_model:
+        Log.fatal("No model file for convert_model, application quit")
+    if config.convert_model_language not in ("", "cpp"):
+        Log.fatal("Unsupported convert_model_language %s (only cpp)",
+                  config.convert_model_language)
+    booster = Booster(model_file=config.input_model)
+    out = config.convert_model or "gbdt_prediction.cpp"
+    with open(out, "w") as f:
+        f.write(model_to_cpp(booster.boosting))
+    Log.info("Finished converting model to C++ code, saved to %s", out)
+
+
 def run_predict(config: Config, params: Dict[str, str]) -> None:
     """Predict path (application.cpp:252-260, predictor.hpp)."""
     if not config.data:
@@ -142,7 +160,7 @@ def main(argv: List[str] = None) -> int:
         elif config.task in ("predict", "prediction", "test"):
             run_predict(config, params)
         elif config.task == "convert_model":
-            Log.fatal("convert_model is not supported on the TPU build")
+            run_convert_model(config, params)
         else:
             Log.fatal("Unknown task type %s", config.task)
     except Exception as ex:  # main.cpp catches and exits non-zero

@@ -147,6 +147,18 @@ def train(
     return booster
 
 
+def _metric_rank(name: str, params: Dict[str, Any]) -> int:
+    """Position of a result metric in the configured metric list (prefix
+    match tolerates decorated names like ndcg@5); unknown -> end."""
+    metric = params.get("metric", "")
+    if isinstance(metric, str):
+        metric = [m.strip() for m in metric.split(",") if m.strip()]
+    for i, m in enumerate(metric or []):
+        if name == m or name.startswith(str(m)):
+            return i
+    return 1 << 30
+
+
 def _record_best_score(booster: Booster, best_score_list) -> None:
     if not best_score_list:
         return
@@ -271,8 +283,10 @@ def cv(
             )
             Log.info("[%d]\t%s", i + 1, msg)
         if early_stopping_rounds and len(history) > early_stopping_rounds:
-            # stop when the first metric hasn't improved
-            (name, bigger) = next(iter(merged.keys()))
+            # stop on the FIRST configured metric (the reference keys
+            # early stopping off config order, not dict iteration order)
+            first = min(merged.keys(), key=lambda kb: _metric_rank(kb[0], params))
+            (name, bigger) = first
             series = results[name + "-mean"]
             best = int(np.argmax(series) if bigger else np.argmin(series))
             if len(series) - 1 - best >= early_stopping_rounds:

@@ -203,3 +203,66 @@ def test_pred_early_stop_wired_into_predict():
     bst.boosting.config.pred_early_stop_margin = 1e9
     never = bst.predict(X[:50])
     np.testing.assert_allclose(never, full, rtol=1e-6, atol=1e-7)
+
+
+def test_convert_model_cpp_compiles_and_matches(tmp_path):
+    """task=convert_model emits standalone C++ whose predictions match the
+    Python predictor (GBDT::ModelToIfElse counterpart)."""
+    import ctypes
+    import shutil
+    import subprocess
+
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.cli import main as cli_main
+
+    rng = np.random.default_rng(5)
+    X = rng.standard_normal((1200, 5)).astype(np.float64)
+    X[:30, 0] = 0.0  # exercise the zero/missing remap
+    w = rng.standard_normal(5)
+    y = (rng.random(1200) < 1 / (1 + np.exp(-(X @ w)))).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1},
+                    lgb.Dataset(X, label=y), 5)
+    model_path = str(tmp_path / "model.txt")
+    bst.save_model(model_path)
+    cpp_path = str(tmp_path / "pred.cpp")
+    rc = cli_main(["task=convert_model", f"input_model={model_path}",
+                   f"convert_model={cpp_path}"])
+    assert not rc
+    so_path = str(tmp_path / "pred.so")
+    subprocess.run(["g++", "-O2", "-shared", "-fPIC", "-o", so_path, cpp_path],
+                   check=True)
+    lib = ctypes.CDLL(so_path)
+    lib.Predict.argtypes = [ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double)]
+    assert lib.GetNumClasses() == 1
+    assert lib.GetNumFeatures() == 5
+    expect = bst.predict(X[:64])
+    out = np.zeros(1, np.float64)
+    got = np.zeros(64)
+    for i in range(64):
+        row = np.ascontiguousarray(X[i], np.float64)
+        lib.Predict(row.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        got[i] = out[0]
+    # the Python predictor accumulates in float32 on device; the C code
+    # is full float64 — tolerance covers the f32 rounding
+    np.testing.assert_allclose(got, expect, rtol=2e-6, atol=2e-7)
+
+
+def test_scipy_sparse_input():
+    """CSR/CSC matrices are accepted (densified; LGBM_DatasetCreateFromCSR
+    counterpart at the python surface)."""
+    scipy = pytest.importorskip("scipy.sparse")
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(0)
+    dense = rng.standard_normal((500, 8)) * (rng.random((500, 8)) < 0.3)
+    y = rng.standard_normal(500).astype(np.float32)
+    for conv in (scipy.csr_matrix, scipy.csc_matrix):
+        bst = lgb.train({"objective": "regression", "num_leaves": 7, "verbose": -1},
+                        lgb.Dataset(conv(dense), label=y), 3)
+        p_sparse = bst.predict(conv(dense[:50]))
+        p_dense = bst.predict(dense[:50])
+        np.testing.assert_allclose(p_sparse, p_dense, rtol=1e-7)
