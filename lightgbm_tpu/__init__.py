@@ -16,47 +16,48 @@ Public API mirrors the reference python-package (python-package/lightgbm):
 __version__ = "0.1.0"
 
 
-def _enable_compile_cache():
+_cache_enabled = False
+
+
+def enable_compile_cache():
     """Persistent XLA compilation cache: the fused training programs take
     ~25 s to compile; caching drops repeat-run warmup to seconds.  Set
-    LIGHTGBM_TPU_COMPILE_CACHE=0 to disable, or point it at a directory."""
+    LIGHTGBM_TPU_COMPILE_CACHE=0 to disable, or point it at a directory.
+
+    Called LAZILY from the training drivers once the backend exists: the
+    cache subdirectory is keyed on the REAL backend platform plus (for
+    host backends) the node name, so artifacts never cross between a
+    remote-compile device population and local CPU compiles, or between
+    machines sharing a home directory (mismatched machine features in a
+    loaded AOT result can SIGILL)."""
+    global _cache_enabled
+    if _cache_enabled:
+        return
     import os
 
     flag = os.environ.get("LIGHTGBM_TPU_COMPILE_CACHE", "")
     if flag == "0":
         return
-    # CPU compiles may be served by a remote compile helper with different
-    # machine features; loading such AOT results risks SIGILL.  Cache only
-    # the (expensive, feature-stable) TPU programs unless explicitly asked:
-    # skip when the run is CPU-bound (env forces cpu, or no TPU plugin is
-    # even importable — checked without touching the backend).
-    if not flag:
-        if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
-            return
-        import importlib.util
-
-        if importlib.util.find_spec("libtpu") is None and importlib.util.find_spec(
-            "jax_plugins"
-        ) is None:
-            return
-    repo_root = os.path.dirname(os.path.dirname(__file__))
-    if flag:
-        path = flag
-    elif os.path.isdir(os.path.join(repo_root, ".git")):
-        path = os.path.join(repo_root, ".jax_cache")  # source checkout
-    else:
-        path = os.path.join(os.path.expanduser("~"), ".cache", "lightgbm_tpu", "jax")
+    _cache_enabled = True
     try:
-        os.makedirs(path, exist_ok=True)
         import jax
 
+        backend = jax.default_backend()
+        sub = backend
+        if backend == "cpu":
+            sub = f"cpu-{os.uname().nodename}"
+        repo_root = os.path.dirname(os.path.dirname(__file__))
+        if flag:
+            path = os.path.join(flag, sub)
+        elif os.path.isdir(os.path.join(repo_root, ".git")):
+            path = os.path.join(repo_root, ".jax_cache", sub)  # source checkout
+        else:
+            path = os.path.join(os.path.expanduser("~"), ".cache", "lightgbm_tpu", "jax", sub)
+        os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:  # pragma: no cover — cache is best-effort
         pass
-
-
-_enable_compile_cache()
 
 from .basic import Booster, Dataset
 from .engine import cv, train

@@ -83,6 +83,11 @@ class GBDT:
         if objective is not None:
             objective.init(train_set.metadata, self.num_data)
 
+        # persistent compile cache, keyed on the now-known backend
+        from .. import enable_compile_cache
+
+        enable_compile_cache()
+
         # device-resident training state
         self.bins = jnp.asarray(train_set.binned)
         self.num_bins = int(train_set.max_num_bin)
@@ -117,30 +122,12 @@ class GBDT:
         elif learner_type != "serial":
             Log.fatal("Unknown tree learner type %s", config.tree_learner)
 
-        # Optional host-driven O(N_leaf) grower (ops/fast_grow).  Only wins
-        # when the device is host-local (sub-ms dispatch): over a tunneled
-        # device its ~4 round-trips per split are 10x slower than the
-        # single-program grower, whose lax.switch compaction tiers already
-        # give O(bucket(N_leaf)) histogram work in-program.  Opt in with
-        # LIGHTGBM_TPU_HOST_GROWER=1.
-        import os as _os
-
-        self.fast_grower = None
-        if (
-            self.learner is None
-            and self.num_data >= 65536
-            and _os.environ.get("LIGHTGBM_TPU_HOST_GROWER", "0") == "1"
-        ):
-            from ..ops.fast_grow import FastGrower
-
-            self.fast_grower = FastGrower(
-                train_set.binned, self.meta, self.hyper, self.grow_params
-            )
-
         # Partitioned fused trainer (ops/pgrow.py): the TPU fast path for
-        # serial single-class training with a row-local objective.
+        # serial single-class training with a row-local objective.  (The
+        # earlier host-driven FastGrower is gone: per-split host round
+        # trips cost ~80 ms over a tunneled device; pgrow supersedes it.)
         self.ptrainer = None
-        if self.learner is None and self.fast_grower is None and self.supports_partitioned:
+        if self.learner is None and self.supports_partitioned:
             from .ptrainer import PartitionedTrainer, eligible as _pt_eligible
 
             if _pt_eligible(config, train_set, objective, self.num_tree_per_iteration):
@@ -230,7 +217,23 @@ class GBDT:
             and self.objective is not None
             and self.objective.boost_from_average
         ):
-            init_score = float(np.mean(np.asarray(self.train_set.metadata.label)))
+            label = np.asarray(self.train_set.metadata.label)
+            import jax as _jax
+
+            if _jax.process_count() > 1:
+                # distributed label average (GBDT::LabelAverage Allreduce,
+                # gbdt.cpp:349-379): every process must boost from the
+                # GLOBAL mean, not its local shard's
+                from jax.experimental import multihost_utils
+
+                sums = np.asarray(
+                    multihost_utils.process_allgather(
+                        np.asarray([label.sum(), float(len(label))])
+                    )
+                )
+                init_score = float(sums[:, 0].sum() / max(sums[:, 1].sum(), 1.0))
+            else:
+                init_score = float(np.mean(label))
             tree = Tree.constant(init_score)
             self.scores = self.scores + jnp.float32(init_score)
             self.valid_scores = [vs + jnp.float32(init_score) for vs in self.valid_scores]
@@ -308,10 +311,6 @@ class GBDT:
                     gr = self.learner.grow(
                         self.bins, grad[k], hess[k], self.select, feature_mask,
                         self.meta, self.hyper,
-                    )
-                elif self.fast_grower is not None:
-                    gr = self.fast_grower.grow(
-                        grad[k], hess[k], self.select, feature_mask
                     )
                 else:
                     gr = grow_tree(
@@ -551,8 +550,6 @@ class GBDT:
         """Re-derive the config-dependent training state after a parameter
         reset (ResetConfig path used by callback.reset_parameter)."""
         self.hyper = SplitHyper.from_config(self.config)
-        if self.fast_grower is not None:
-            self.fast_grower.hyper = self.hyper
         if self.ptrainer is not None:
             # the compiled chunk programs bake hyper/config in as closure
             # constants — swap state and drop the program cache

@@ -152,7 +152,8 @@ def _metric_rank(name: str, params: Dict[str, Any]) -> int:
     match tolerates decorated names like ndcg@5); unknown -> end."""
     metric = params.get("metric", "")
     if isinstance(metric, str):
-        metric = [m.strip() for m in metric.split(",") if m.strip()]
+        # Config._parse_list accepts comma OR whitespace separators
+        metric = [m for m in metric.replace(",", " ").split() if m]
     for i, m in enumerate(metric or []):
         if name == m or name.startswith(str(m)):
             return i
