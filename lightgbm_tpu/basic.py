@@ -88,12 +88,20 @@ class Dataset:
         self.label_idx = 0
 
     # ------------------------------------------------------------------
-    def construct(self) -> BinnedDataset:
-        """Build (or return) the binned dataset (basic.py _lazy_init)."""
+    def construct(self, extra_params: Optional[Dict[str, Any]] = None) -> BinnedDataset:
+        """Build (or return) the binned dataset (basic.py _lazy_init).
+
+        ``extra_params`` fill gaps for this construction only (booster
+        params reaching the dataset) — the Dataset's own ``params`` win
+        and are never mutated, so the same un-constructed Dataset can be
+        reused by a second Booster with different params.
+        """
         if self._constructed is not None:
             return self._constructed
+        merged = dict(extra_params) if extra_params else {}
+        merged.update(self.params)
         cfg = Config.from_params(
-            {k: v for k, v in self.params.items() if k != "categorical_feature"}
+            {k: v for k, v in merged.items() if k != "categorical_feature"}
         )
         if self.data is None and self.data_path is not None:
             # binary dataset cache first (DatasetLoader::LoadFromBinFile)
@@ -272,11 +280,9 @@ class Booster:
             self.config = Config.from_params(self.params)
             # dataset-relevant train params reach construction unless the
             # Dataset set them explicitly (Dataset._update_params: the
-            # dataset's own params win, booster params fill the gaps)
-            if train_set._constructed is None:
-                for k, v in self.params.items():
-                    train_set.params.setdefault(k, v)
-            binned = train_set.construct()
+            # dataset's own params win, booster params fill the gaps) —
+            # passed per-construction, never written into train_set.params
+            binned = train_set.construct(extra_params=self.params)
             self.train_dataset = train_set
             self.objective = create_objective(self.config)
             self.boosting = create_boosting(self.config.boosting_type)

@@ -586,17 +586,28 @@ class GBDT:
         n = data.shape[0]
         if not models:
             return np.zeros((k, n))
-        data_dev = jnp.asarray(np.asarray(data, np.float32))
+        from ..model.ensemble import split_hi_lo
+
+        hi, lo, lo2 = split_hi_lo(np.asarray(data, np.float64))
+        data_hi = jnp.asarray(hi)
+        data_lo = jnp.asarray(lo)
+        data_lo2 = jnp.asarray(lo2)
         arrays = stack_trees(models)
         out = np.zeros((k, n))
         for kk in range(k):
             idx = np.asarray([i for i in range(len(models)) if i % k == kk])
             out[kk] = np.asarray(
                 predict_raw(
-                    data_dev,
+                    data_hi,
+                    data_lo,
+                    data_lo2,
                     arrays["split_feature"][idx],
                     arrays["threshold_real"][idx],
+                    arrays["threshold_real_lo"][idx],
+                    arrays["threshold_real_lo2"][idx],
                     arrays["default_value"][idx],
+                    arrays["default_value_lo"][idx],
+                    arrays["default_value_lo2"][idx],
                     arrays["is_categorical"][idx],
                     arrays["left_child"][idx],
                     arrays["right_child"][idx],

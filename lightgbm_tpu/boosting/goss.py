@@ -43,8 +43,10 @@ class GOSS(GBDT):
         multiply = (n - top_k) / other_k
 
         score = jnp.sum(jnp.abs(grad * hess), axis=0)  # (N,)
-        threshold = jax.lax.top_k(score, top_k)[0][-1]
-        is_top = score >= threshold
+        # exactly top_k rows (goss.hpp:96-124 ArgMaxAtK) — a >=threshold test
+        # would keep extra rows on ties and silently raise the sampling rate
+        _, top_idx = jax.lax.top_k(score, top_k)
+        is_top = jnp.zeros(n, bool).at[top_idx].set(True)
         self._goss_key, sub = jax.random.split(self._goss_key)
         rest_all = n - top_k
         prob = other_k / max(rest_all, 1)

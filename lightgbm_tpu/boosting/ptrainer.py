@@ -229,7 +229,12 @@ class PartitionedTrainer:
                 # iterations must not touch the scores either, or the
                 # channel would contain trees that are not in the model.
                 keep = ((tree.num_splits > 0) & (~stopped)).astype(jnp.float32)
-                delta = segment_values(tree, n, lr * keep * tree.leaf_value)
+                # clamp like Tree.shrinkage (tree.h:13 kMaxTreeOutput): the
+                # persisted tree stores clip(lr*value, +-100), so the score
+                # channel must apply the same clip or training-time scores
+                # diverge from what the stored model predicts
+                lval = jnp.clip(lr * tree.leaf_value, -100.0, 100.0)
+                delta = segment_values(tree, n, keep * lval)
                 score2 = _i2f(p[lay.SCORE, :n]) + delta
                 p = jnp.concatenate(
                     [p[: lay.SCORE], row(_f2i(score2)), p[lay.SCORE + 1 :]], axis=0
@@ -253,7 +258,7 @@ class PartitionedTrainer:
                 pick = lambda a, b: jnp.where(kept, a, b)
                 return (p, scratch, recs, new_stopped,
                         pick(tree.starts, last_starts), pick(tree.cnts, last_cnts),
-                        pick(lr * keep * tree.leaf_value, last_vals),
+                        pick(keep * lval, last_vals),
                         pick(tree.num_splits, last_ns))
 
             m = L - 1

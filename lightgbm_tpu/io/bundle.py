@@ -59,8 +59,12 @@ class BundleInfo:
         return len(self.groups)
 
 
-def _popcount64(x: np.ndarray) -> int:
-    return int(np.bitwise_count(x).sum())
+if hasattr(np, "bitwise_count"):  # numpy >= 2.0
+    def _popcount64(x: np.ndarray) -> int:
+        return int(np.bitwise_count(x).sum())
+else:
+    def _popcount64(x: np.ndarray) -> int:
+        return int(np.unpackbits(x.view(np.uint8)).sum())
 
 
 def _find_groups(nonzero: List[np.ndarray], order: np.ndarray,

@@ -12,6 +12,28 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def split_hi_lo(x: np.ndarray):
+    """Triple-float (hi, lo, lo2) planes of a float64 array:
+    hi = f32(x), lo = f32(x - hi), lo2 = f32(x - hi - lo).
+
+    A lexicographic (hi, lo, lo2) comparison reproduces the float64
+    ``<=`` EXACTLY for f64-sourced values: the TPU has no native f64,
+    and a single-f32 comparison flips tree decisions whenever a feature
+    value lands within f32 rounding of a threshold
+    (Tree::NumericalDecision is a double compare, tree.h:139-145).
+    Two planes (~2^-48 rel) still collapse 1-ulp f64 differences
+    (2^-52); the third plane (~2^-72) discriminates every distinct f64
+    pair, so equality of triples implies equality of doubles."""
+    f32max = np.finfo(np.float32).max
+    c = np.clip(x, -f32max, f32max)
+    hi = c.astype(np.float32)
+    r1 = c - hi.astype(np.float64)
+    lo = np.clip(r1, -f32max, f32max).astype(np.float32)
+    r2 = r1 - lo.astype(np.float64)
+    lo2 = np.clip(r2, -f32max, f32max).astype(np.float32)
+    return hi, lo, lo2
+
+
 def stack_trees(trees: List) -> dict:
     """Pad T trees to (T, M)/(T, L) arrays.  Unused node slots point at
     leaf 0; a 1-leaf tree gets a sentinel node routing everything to its
@@ -26,10 +48,10 @@ def stack_trees(trees: List) -> dict:
     split_feature = zf((t, m), np.int32)
     split_feature_inner = zf((t, m), np.int32)
     threshold_bin = zf((t, m), np.int32)
-    threshold_real = zf((t, m), np.float32)
+    threshold_real = zf((t, m), np.float64)
     zero_bin = zf((t, m), np.int32)
     dbz = zf((t, m), np.int32)
-    default_value = zf((t, m), np.float32)
+    default_value = zf((t, m), np.float64)
     is_cat = zf((t, m), np.bool_)
     left = np.full((t, m), -1, np.int32)
     right = np.full((t, m), -1, np.int32)
@@ -46,27 +68,32 @@ def stack_trees(trees: List) -> dict:
             leaf_value[i, 0] = tr.leaf_value[0]
             continue
         k = n - 1
-        f32max = np.finfo(np.float32).max
         split_feature[i, :k] = tr.split_feature[:k]
         split_feature_inner[i, :k] = tr.split_feature_inner[:k]
         threshold_bin[i, :k] = tr.threshold_in_bin[:k]
-        threshold_real[i, :k] = np.clip(tr.threshold[:k], -f32max, f32max)
+        threshold_real[i, :k] = tr.threshold[:k]
         zero_bin[i, :k] = tr.zero_bin[:k]
         dbz[i, :k] = tr.default_bin_for_zero[:k]
-        default_value[i, :k] = np.clip(tr.default_value[:k], -f32max, f32max)
+        default_value[i, :k] = tr.default_value[:k]
         is_cat[i, :k] = tr.decision_type[:k] == 1
         left[i, :k] = tr.left_child[:k]
         right[i, :k] = tr.right_child[:k]
         leaf_value[i, :n] = tr.leaf_value[:n]
 
+    thr_hi, thr_lo, thr_lo2 = split_hi_lo(threshold_real)
+    dv_hi, dv_lo, dv_lo2 = split_hi_lo(default_value)
     return {
         "split_feature": jnp.asarray(split_feature),
         "split_feature_inner": jnp.asarray(split_feature_inner),
         "threshold_bin": jnp.asarray(threshold_bin),
-        "threshold_real": jnp.asarray(threshold_real),
+        "threshold_real": jnp.asarray(thr_hi),
+        "threshold_real_lo": jnp.asarray(thr_lo),
+        "threshold_real_lo2": jnp.asarray(thr_lo2),
         "zero_bin": jnp.asarray(zero_bin),
         "default_bin_for_zero": jnp.asarray(dbz),
-        "default_value": jnp.asarray(default_value),
+        "default_value": jnp.asarray(dv_hi),
+        "default_value_lo": jnp.asarray(dv_lo),
+        "default_value_lo2": jnp.asarray(dv_lo2),
         "is_categorical": jnp.asarray(is_cat),
         "left_child": jnp.asarray(left),
         "right_child": jnp.asarray(right),

@@ -1,0 +1,97 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The image has g++ but no pybind11, so the extension is a plain C ABI
+shared library compiled on first use and cached next to the source
+(keyed by a hash of the .cpp, so editing the source recompiles).
+``get_lib()`` returns the loaded library or None when no compiler is
+available — callers must keep a pure-Python fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "parser.cpp")
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build(src: str, out: str) -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread", src, "-o", out]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("LIGHTGBM_TPU_NO_NATIVE"):
+            return None
+        try:
+            with open(_SRC, "rb") as f:
+                digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        except OSError:
+            return None
+        cache_dir = os.environ.get(
+            "LIGHTGBM_TPU_NATIVE_CACHE", os.path.join(_HERE, "_build")
+        )
+        so = os.path.join(cache_dir, f"parser_{digest}.so")
+        if not os.path.exists(so):
+            try:
+                os.makedirs(cache_dir, exist_ok=True)
+            except OSError:
+                return None
+            tmp = so + f".tmp{os.getpid()}"
+            if not _build(_SRC, tmp):
+                return None
+            os.replace(tmp, so)
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            return None
+        c_char_p = ctypes.c_char_p
+        i64, i32, dbl = ctypes.c_int64, ctypes.c_int, ctypes.c_double
+        pd = ctypes.POINTER(ctypes.c_double)
+        vp = ctypes.c_void_p
+        lib.ltpu_scan.argtypes = [c_char_p, i64]
+        lib.ltpu_scan.restype = vp
+        lib.ltpu_scan_free.argtypes = [vp]
+        lib.ltpu_scan_free.restype = None
+        lib.ltpu_dims_csv.argtypes = [vp, c_char_p, ctypes.c_char, i32,
+                                      ctypes.POINTER(i64), ctypes.POINTER(i32)]
+        lib.ltpu_dims_csv.restype = i32
+        lib.ltpu_parse_csv.argtypes = [vp, c_char_p, ctypes.c_char, i32,
+                                       pd, i64, i32, i32]
+        lib.ltpu_parse_csv.restype = i32
+        lib.ltpu_dims_libsvm.argtypes = [vp, c_char_p, ctypes.POINTER(i64),
+                                         ctypes.POINTER(i32)]
+        lib.ltpu_dims_libsvm.restype = i32
+        lib.ltpu_parse_libsvm.argtypes = [vp, c_char_p, pd, pd, i64, i32, i32]
+        lib.ltpu_parse_libsvm.restype = i32
+        lib.ltpu_atof.argtypes = [c_char_p]
+        lib.ltpu_atof.restype = dbl
+        _LIB = lib
+        return _LIB
+
+
+def atof(s: str) -> float:
+    """Reference-compatible Atof (common.h:163-261) of one token."""
+    lib = get_lib()
+    if lib is None:
+        return float(s)
+    return lib.ltpu_atof(s.encode())

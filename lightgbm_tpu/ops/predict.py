@@ -27,6 +27,27 @@ import jax.numpy as jnp
 from ..io.binning import MISSING_VALUE_RANGE
 
 
+def _le3(ah, al, al2, bh, bl, bl2):
+    """Lexicographic ``a <= b`` over triple-float planes — exact f64
+    semantics (see model/ensemble.py split_hi_lo)."""
+    return (
+        (ah < bh)
+        | ((ah == bh) & (al < bl))
+        | ((ah == bh) & (al == bl) & (al2 <= bl2))
+    )
+
+
+# triple-float planes of kMissingValueRange so the zero/missing-range
+# test itself is f64-exact (a double just above the range must NOT be
+# remapped merely because its f32 rounding lands inside it)
+import numpy as _np
+
+_MR = float(MISSING_VALUE_RANGE)
+_MR_HI = _np.float32(_MR)
+_MR_LO = _np.float32(_MR - float(_MR_HI))
+_MR_LO2 = _np.float32(_MR - float(_MR_HI) - float(_MR_LO))
+
+
 class TreeArrays:
     """Stacked SoA node arrays for T trees, padded to M = max nodes.
 
@@ -39,10 +60,14 @@ class TreeArrays:
         "split_feature",  # (T, M) int32 — inner (binned) feature for binned path
         "split_feature_real",  # (T, M) int32 — original feature for raw path
         "threshold_bin",  # (T, M) int32
-        "threshold_real",  # (T, M) f32
+        "threshold_real",  # (T, M) f32 hi plane
+        "threshold_real_lo",  # (T, M) f32 lo plane (triple-float compare)
+        "threshold_real_lo2",  # (T, M) f32 lo2 plane
         "zero_bin",  # (T, M) int32
         "default_bin_for_zero",  # (T, M) int32
-        "default_value_real",  # (T, M) f32
+        "default_value_real",  # (T, M) f32 hi plane
+        "default_value_real_lo",  # (T, M) f32 lo plane
+        "default_value_real_lo2",  # (T, M) f32 lo2 plane
         "is_categorical",  # (T, M) bool
         "left_child",  # (T, M) int32  (>=0 node, <0 → leaf ~idx)
         "right_child",  # (T, M) int32
@@ -78,8 +103,18 @@ def _traverse_one_tree_binned(bins, feat, thr_bin, zero_bin, dbz, is_cat, left, 
     return ~node  # leaf index
 
 
-def _traverse_one_tree_raw(data, feat, thr, default_value, is_cat, left, right):
-    n = data.shape[0]
+def _traverse_one_tree_raw(data_hi, data_lo, data_lo2, feat,
+                           thr_hi, thr_lo, thr_lo2,
+                           dv_hi, dv_lo, dv_lo2, is_cat, left, right):
+    """Raw traversal with triple-float (hi, lo, lo2) planes.
+
+    The reference decides in float64 (NumericalDecision<double>,
+    tree.h:139-145); TPU f32 alone flips rows whose value is within f32
+    rounding of a threshold.  A lexicographic compare over normalized
+    (hi, lo, lo2) triples reproduces the double ``<=`` exactly
+    (see model/ensemble.py split_hi_lo).  Categorical identity uses the
+    hi plane only — category ids are small exact integers."""
+    n = data_hi.shape[0]
     rows = jnp.arange(n)
 
     def cond(node):
@@ -87,12 +122,23 @@ def _traverse_one_tree_raw(data, feat, thr, default_value, is_cat, left, right):
 
     def step(node):
         j = jnp.maximum(node, 0)
-        v = data[rows, feat[j]]
-        # DefaultValueForZero: |v| in (-range, range] → default_value
-        is_zero = (v > -MISSING_VALUE_RANGE) & (v <= MISSING_VALUE_RANGE)
-        is_zero = is_zero | jnp.isnan(v)  # NaN rides the zero bin (ValueToBin)
-        fval = jnp.where(is_zero, default_value[j], v)
-        goes_left = jnp.where(is_cat[j], fval.astype(jnp.int32) == thr[j].astype(jnp.int32), fval <= thr[j])
+        v_hi = data_hi[rows, feat[j]]
+        v_lo = data_lo[rows, feat[j]]
+        v_lo2 = data_lo2[rows, feat[j]]
+        # DefaultValueForZero: |v| in (-range, range] → default_value,
+        # with the range test itself done in triple-float (f64-exact)
+        gt_neg = ~_le3(v_hi, v_lo, v_lo2, -_MR_HI, -_MR_LO, -_MR_LO2)
+        le_pos = _le3(v_hi, v_lo, v_lo2, _MR_HI, _MR_LO, _MR_LO2)
+        is_zero = gt_neg & le_pos
+        is_zero = is_zero | jnp.isnan(v_hi)  # NaN rides the zero bin (ValueToBin)
+        f_hi = jnp.where(is_zero, dv_hi[j], v_hi)
+        f_lo = jnp.where(is_zero, dv_lo[j], v_lo)
+        f_lo2 = jnp.where(is_zero, dv_lo2[j], v_lo2)
+        le = _le3(f_hi, f_lo, f_lo2, thr_hi[j], thr_lo[j], thr_lo2[j])
+        t_hi = thr_hi[j]
+        goes_left = jnp.where(
+            is_cat[j], f_hi.astype(jnp.int32) == t_hi.astype(jnp.int32), le
+        )
         nxt = jnp.where(goes_left, left[j], right[j])
         return jnp.where(node >= 0, nxt, node)
 
@@ -127,12 +173,17 @@ def predict_leaf_binned(bins, split_feature, threshold_bin, zero_bin,
 
 
 @jax.jit
-def predict_raw(data, split_feature_real, threshold_real, default_value_real,
+def predict_raw(data_hi, data_lo, data_lo2, split_feature_real, threshold_real,
+                threshold_real_lo, threshold_real_lo2,
+                default_value_real, default_value_real_lo, default_value_real_lo2,
                 is_categorical, left_child, right_child, leaf_value):
-    """(N,) raw scores over real-valued features."""
+    """(N,) raw scores over real-valued features (triple-float planes)."""
     leaves = jax.vmap(
-        _traverse_one_tree_raw, in_axes=(None, 0, 0, 0, 0, 0, 0)
-    )(data, split_feature_real, threshold_real, default_value_real,
+        _traverse_one_tree_raw,
+        in_axes=(None, None, None, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0),
+    )(data_hi, data_lo, data_lo2, split_feature_real,
+      threshold_real, threshold_real_lo, threshold_real_lo2,
+      default_value_real, default_value_real_lo, default_value_real_lo2,
       is_categorical, left_child, right_child)
     vals = jnp.take_along_axis(leaf_value, leaves, axis=1)
     return jnp.sum(vals, axis=0)

@@ -1,0 +1,33 @@
+#!/bin/bash
+# Regenerate the golden reference outputs in tests/golden/ from the
+# actual reference implementation (/root/reference, built into
+# /root/repo/refbuild/lightgbm — see refbuild/cmake.log).
+#
+# Sampling params are forced deterministic (feature_fraction=1.0, no
+# bagging): the two implementations use different RNG streams, so only
+# the sampling-free configuration is comparable tree-for-tree.
+set -e
+BIN=${LIGHTGBM_BIN:-/root/repo/refbuild/lightgbm}
+EX=/root/reference/examples
+OUT=$(cd "$(dirname "$0")" && pwd)
+DET="feature_fraction=1.0 bagging_freq=0 bagging_fraction=1.0 num_trees=30 is_training_metric=false"
+
+run() { # name confdir extra...
+  local name=$1 dir=$2; shift 2
+  local wd=$(mktemp -d)
+  cp "$EX/$dir/"*.train "$EX/$dir/"*.test "$wd/" 2>/dev/null || true
+  cp "$EX/$dir/"*.query "$wd/" 2>/dev/null || true
+  (cd "$wd" && "$BIN" config="$EX/$dir/train.conf" $DET "$@" \
+      output_model="$OUT/${name}_model.txt" 2>&1 | grep -E "Iteration:(30|29)," | tail -4 \
+      > "$OUT/${name}_train_metrics.txt")
+  (cd "$wd" && "$BIN" config="$EX/$dir/predict.conf" \
+      input_model="$OUT/${name}_model.txt" \
+      output_result="$OUT/${name}_pred.txt" > /dev/null 2>&1)
+  rm -rf "$wd"
+  echo "golden: $name"
+}
+
+run binary binary_classification
+run regression regression
+run multiclass multiclass_classification
+run lambdarank lambdarank

@@ -1,0 +1,89 @@
+"""Native C++ parser: reference-exact Atof semantics and file parsing.
+
+Covers the knife-edge class that motivated the native parser: the
+reference's Common::Atof (common.h:163-261) is NOT correctly rounded, and
+bin thresholds are midpoints of Atof-parsed values, so parity requires
+bit-identical parsing (see native/parser.cpp header).
+"""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.parser import load_text_file
+from lightgbm_tpu.native import atof, get_lib
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = get_lib()
+    if lib is None:
+        pytest.skip("no compiler for native parser")
+    return lib
+
+
+def test_atof_non_correctly_rounded(lib):
+    # 1.413: digit accumulation gives 1 ulp below strtod
+    assert atof("1.413") == 1.4129999999999998
+    assert atof("1.413") != float("1.413")
+    # exact cases agree
+    for s in ["2", "0", "-7", "0.5", "123.25", "1e3", "-2.5e-2"]:
+        assert atof(s) == float(s), s
+
+
+def test_atof_word_tokens(lib):
+    assert atof("na") == 0.0
+    assert atof("NaN") == 0.0
+    assert atof("inf") == 1e308
+    assert atof("-inf") == -1e308
+    assert atof("") == 0.0  # empty token keeps the 0 init (common.h:232)
+
+
+def test_csv_empty_fields(tmp_path, lib):
+    # empty fields parse as 0.0 exactly like the reference, NOT NaN
+    p = tmp_path / "d.csv"
+    p.write_text("1,,3\n4,5,\n,,\n")
+    feats, label, _, _, _, _ = load_text_file(str(p), Config())
+    mat = np.column_stack([label, feats])
+    np.testing.assert_array_equal(mat, [[1, 0, 3], [4, 5, 0], [0, 0, 0]])
+
+
+def test_tsv_and_blank_lines(tmp_path, lib):
+    p = tmp_path / "d.tsv"
+    p.write_text("1\t2\t3\n\n4\t5\t6\n   \n")
+    feats, label, _, _, _, _ = load_text_file(str(p), Config())
+    assert feats.shape == (2, 2)
+    np.testing.assert_array_equal(label, [1, 4])
+
+
+def test_header_names(tmp_path, lib):
+    p = tmp_path / "d.csv"
+    p.write_text("y,a,b\n0,1.5,2.5\n1,3.5,na\n")
+    cfg = Config.from_params({"has_header": True})
+    feats, label, _, _, names, _ = load_text_file(str(p), cfg)
+    assert names == ["a", "b"]
+    np.testing.assert_array_equal(label, [0, 1])
+    np.testing.assert_array_equal(feats, [[1.5, 2.5], [3.5, 0.0]])
+
+
+def test_libsvm_matches_python_fallback(tmp_path, lib):
+    p = tmp_path / "d.svm"
+    p.write_text("1 0:1.413 3:2.5\n0 1:-7\n2 2:1e-3 3:4\n")
+    feats, label, _, _, _, _ = load_text_file(str(p), Config())
+    assert feats.shape == (3, 4)
+    assert feats[0, 0] == atof("1.413")
+    assert feats[1, 1] == -7.0
+    assert feats[2, 3] == 4.0
+    np.testing.assert_array_equal(label, [1, 0, 2])
+
+
+def test_large_random_matches_pandas_within_ulp(tmp_path, lib):
+    rng = np.random.default_rng(0)
+    vals = rng.normal(size=(500, 8)).round(4)
+    p = tmp_path / "big.csv"
+    np.savetxt(p, vals, delimiter=",", fmt="%.4f")
+    feats, label, _, _, _, _ = load_text_file(str(p), Config())
+    # Atof differs from strtod by <= a few ulps; the label column is
+    # downcast to f32 by design (Metadata stores float labels)
+    np.testing.assert_allclose(feats, vals[:, 1:], rtol=1e-14)
+    np.testing.assert_allclose(label, vals[:, 0], rtol=1e-6)
