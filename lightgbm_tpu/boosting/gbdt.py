@@ -35,10 +35,11 @@ K_MIN_SCORE = -np.inf
 class GBDT:
     """The gradient-boosting driver (class GBDT, gbdt.h:24-258)."""
 
-    # DART/GOSS override: their per-iteration hooks (drop/normalize,
-    # gradient resampling) are host-side and incompatible with the fused
-    # partitioned trainer.
+    # DART overrides: its per-iteration hooks (drop/normalize) are
+    # host-side and incompatible with the fused partitioned trainer.
     supports_partitioned = True
+    # data-parallel fused path (GOSS needs a global top_k, not sharded yet)
+    supports_partitioned_data = True
 
     def __init__(self):
         self.models: List[Tree] = []
@@ -105,6 +106,7 @@ class GBDT:
         # over the device mesh
         learner_type = config.tree_learner.lower()
         self.learner = None
+        self.ptrainer = None
         if learner_type in ("data", "feature", "voting"):
             import jax as _jax
 
@@ -116,9 +118,32 @@ class GBDT:
                     "visible; falling back to serial", learner_type,
                 )
             else:
-                self.learner = ShardedLearner(
-                    learner_type, make_mesh(), self.grow_params
-                )
+                # data-parallel rides the partitioned fast path when
+                # eligible (histogram psum per split); feature/voting
+                # keep the mask grower's collective formulations
+                if (learner_type == "data" and self.supports_partitioned
+                        and self.supports_partitioned_data
+                        and self.num_tree_per_iteration == 1):
+                    from .ptrainer import (
+                        ShardedPartitionedTrainer,
+                        eligible as _pt_eligible,
+                    )
+
+                    if _pt_eligible(config, train_set, objective,
+                                    self.num_tree_per_iteration):
+                        self.ptrainer = ShardedPartitionedTrainer(
+                            train_set, config, objective, self.meta,
+                            self.hyper, make_mesh(),
+                        )
+                        Log.info(
+                            "Using data-parallel partitioned (fused) TPU "
+                            "tree learner over %d devices",
+                            self.ptrainer.d,
+                        )
+                if self.ptrainer is None:
+                    self.learner = ShardedLearner(
+                        learner_type, make_mesh(), self.grow_params
+                    )
         elif learner_type != "serial":
             Log.fatal("Unknown tree learner type %s", config.tree_learner)
 
@@ -126,8 +151,7 @@ class GBDT:
         # serial single-class training with a row-local objective.  (The
         # earlier host-driven FastGrower is gone: per-split host round
         # trips cost ~80 ms over a tunneled device; pgrow supersedes it.)
-        self.ptrainer = None
-        if self.learner is None and self.supports_partitioned:
+        if self.learner is None and self.ptrainer is None and self.supports_partitioned:
             from .ptrainer import PartitionedTrainer, eligible as _pt_eligible
 
             if _pt_eligible(config, train_set, objective, self.num_tree_per_iteration):
@@ -369,20 +393,26 @@ class GBDT:
             return False
         self._boost_from_average()
         pt = self.ptrainer
+        K = self.num_tree_per_iteration
         if pt.score_dirty:
-            pt.sync_scores_from(self.scores[0])
+            pt.sync_scores_from(self.scores if K > 1 else self.scores[0])
         with timetag.phase("tree"):
             recs, scores_orig, n_done = pt.train_chunk(
                 num_iters, self.shrinkage_rate, self.iter
             )
         with timetag.phase("train_score"):
-            self.scores = scores_orig[None, :]
+            self.scores = scores_orig[None, :] if K == 1 else scores_orig
         for t in range(n_done):
-            tree = Tree.from_grow_result(pt.grow_result_view(recs, t), self.train_set)
-            tree.shrinkage(self.shrinkage_rate)
-            self.models.append(tree)
-            with timetag.phase("valid_score"):
-                self._add_tree_to_valid_scores(tree, 0)
+            for k in range(K):
+                view = pt.grow_result_view(recs, t, k)
+                if int(view.num_splits) > 0:
+                    tree = Tree.from_grow_result(view, self.train_set)
+                    tree.shrinkage(self.shrinkage_rate)
+                else:
+                    tree = Tree(2)  # empty tree, kept for class alignment
+                self.models.append(tree)
+                with timetag.phase("valid_score"):
+                    self._add_tree_to_valid_scores(tree, k)
         self.iter += n_done
         if n_done < num_iters:
             Log.warning(

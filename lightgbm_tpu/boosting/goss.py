@@ -17,7 +17,11 @@ from .gbdt import GBDT
 
 
 class GOSS(GBDT):
-    supports_partitioned = False  # host-side gradient resampling hooks
+    # the fused partitioned trainer implements GOSS natively (device
+    # top_k + Bernoulli rest inside the chunk program); the hooks below
+    # remain for the mask-grower fallback
+    supports_partitioned = True
+    supports_partitioned_data = False  # global top_k not sharded yet
 
     def init(self, config, train_set, objective, training_metrics=()):
         super().init(config, train_set, objective, training_metrics)

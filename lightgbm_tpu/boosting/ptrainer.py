@@ -1,23 +1,50 @@
-"""Fused partitioned trainer — boosting iterations as ONE device program.
+"""Fused partitioned trainers — boosting iterations as ONE device program.
 
-Drives ops/pgrow.py for the serial single-class path.  The motivation is
-dispatch latency: a host round-trip to the (possibly tunneled) TPU costs
-up to ~80 ms, so the reference's per-iteration host loop
-(GBDT::TrainOneIter, gbdt.cpp:381-495) becomes a ``lax.fori_loop`` over
-iterations INSIDE one jitted program:
+Drives ops/pgrow.py.  The motivation is dispatch latency: a host round
+trip to the (possibly tunneled) TPU costs up to ~80 ms, so the
+reference's per-iteration host loop (GBDT::TrainOneIter,
+gbdt.cpp:381-495) becomes a ``lax.fori_loop`` over iterations INSIDE one
+jitted program.  Per iteration:
 
-    gradients (from the score/label channels, in permuted row space)
-    -> bagging mask -> feature sampling -> grow_tree_partitioned
-    -> in-place per-segment score update -> split records[t]
+  K == 1 (binary/regression, incl. GOSS):
+    update_and_root_hist kernel (score += PREVIOUS tree's pending delta;
+      fresh gradients from the score/label channels; bagging select; the
+      root histogram of the fresh values)           [in-place Pallas]
+    -> feature sampling -> grow_tree_partitioned    [split_stream kernels]
+    -> the tree's score delta is carried PENDING to the next iteration's
+       update (the row layout doesn't change in between) and settled by
+       one extra pass at chunk end.
+    GOSS prepends a gradient-only pass + device top_k/Bernoulli sampling
+    with the (n-top_k)/other_k up-weighting folded into g/h (goss.hpp).
+
+  K > 1 (multiclass): ALL K gradient planes + K root histograms come
+    from ONE streaming pass over the same score snapshot
+    (update_multi_and_hists — GBDT::Boosting computes every class's
+    gradients once per iteration, gbdt.cpp:692-700); each class's tree
+    then reads its own g/h channel pair, and its leaf deltas land on its
+    score row IMMEDIATELY after the tree via the score_add streamer,
+    while the delta's partition layout is still current.  (Deltas must
+    never stay pending across another class's tree: each tree physically
+    re-permutes the rows.)
 
 Scores, labels and weights travel as bitcast channels of the packed
 matrix, so nothing is ever gathered back to original row order during
-training; the (N,) original-order score vector is rebuilt ONCE per chunk
-(a single scatter through the rowid channel) for metrics/eval.
+training; the original-order score vectors are rebuilt ONCE per chunk
+(one scatter per class through the rowid channel) for metrics/eval.
+
+Why every channel write goes through a Pallas kernel: ANY XLA-level
+write to the 64 MB matrix — even a one-element ``.at[].set`` on a
+donated loop carry — triggers a pathological whole-array copy
+(~50-180 ms measured) on this backend; only ``input_output_aliases``
+mutate truly in place.
 
 Row-order-free semantics this relies on: histograms, leaf statistics and
 elementwise objectives are permutation-invariant.  Ranking objectives
 (query-grouped) are not — they keep the mask-based grower (ops/grow.py).
+
+``ShardedPartitionedTrainer`` runs the same fused loop per shard under
+``shard_map`` with per-split histogram psums — the data-parallel learner
+(data_parallel_tree_learner.cpp) on the fast kernels.
 
 Deliberate parity divergences from the reference (documented):
 - bagging draws a per-row Bernoulli(bagging_fraction) mask with JAX
@@ -25,6 +52,8 @@ Deliberate parity divergences from the reference (documented):
   (gbdt.cpp:275-334); same distribution, different stream.
 - feature_fraction samples exactly ceil(frac*F) features via device
   top_k on uniform keys instead of utils/random.py's host sampler.
+- GOSS's rest-sample is Bernoulli(other_k/rest) rather than an exact
+  other_k-subset; the top set is exact top_k like the reference.
 """
 
 from __future__ import annotations
@@ -43,7 +72,14 @@ from ..ops.pgrow import (
     grow_tree_partitioned,
     segment_values,
 )
-from ..ops.pkernels import PLayout, pack_matrix_device
+from ..ops.pkernels import (
+    PLayout,
+    pack_matrix_device,
+    score_add,
+    update_and_root_hist,
+    update_channels,
+    update_multi_and_hists,
+)
 from ..ops.split import FeatureMeta, SplitHyper
 from ..utils.log import Log
 
@@ -66,6 +102,9 @@ class PartitionedTrainer:
         assert binned.dtype == np.uint8
         md = train_set.metadata
         self.has_weights = md.weights is not None
+        # K > 1: multiclass — K score channels, K trees per iteration
+        # (per-class tree loop, gbdt.cpp:445-480)
+        self.K = int(getattr(objective, "num_tree_per_iteration", 1))
         # EFB: stream the bundled (N, G) matrix instead of (N, F) when the
         # dataset found exclusive bundles (io/bundle.py); split search and
         # the model stay in real-feature space via BundleMeta
@@ -91,12 +130,11 @@ class PartitionedTrainer:
             bits = int(force_bits)
             if bits == 4 and max_col_bin > 16:
                 bits = 8  # cannot pack >16 bins in 4 bits
-        self.layout = PLayout(matrix.shape[1], num_score=1, with_weight=True, bits=bits)
+        self.layout = PLayout(matrix.shape[1], num_score=self.K, with_weight=True, bits=bits)
         if bins_dev is None:
             bins_dev = jnp.asarray(np.asarray(matrix))
         self.p = pack_matrix_device(bins_dev, self.layout, label=md.label,
                                     weight=md.weights if self.has_weights else None)
-        self.scratch = jnp.zeros_like(self.p)
         self.num_rows = n
         self.meta = meta
         self.hyper = hyper
@@ -120,95 +158,114 @@ class PartitionedTrainer:
         # gather, cheap)
         self.score_dirty = True
         self._progs = {}
-        self._last_tree = None  # (starts, cnts, scaled leaf deltas) for rollback
+        self._apply_prog = None
+        self._last_tree = None  # (N,) scaled leaf-delta vector, for rollback
         self._base_key = jax.random.PRNGKey(
             (int(config.bagging_seed) << 1) ^ int(config.feature_fraction_seed)
         )
 
     # -- score channel maintenance ------------------------------------
-    def add_score_constant(self, c: float) -> None:
-        lay = self.layout
-        sc = _i2f(self.p[lay.SCORE]) + jnp.float32(c)
-        self.p = self.p.at[lay.SCORE].set(_f2i(sc))
-
-    def sync_scores_from(self, scores_orig) -> None:
-        """Permute an original-order (N,) score vector into the channel
-        (one gather through rowid; rare — init_model / external updates)."""
-        lay = self.layout
-        rowid = self.p[lay.ROWID, : self.num_rows]
-        perm = jnp.asarray(scores_orig, jnp.float32)[rowid]
-        padded = jnp.zeros((self.p.shape[1],), jnp.float32).at[: self.num_rows].set(perm)
-        self.p = self.p.at[lay.SCORE].set(_f2i(padded))
-        self.score_dirty = False
-
-    def scores_original_order(self):
-        lay = self.layout
-        rowid = self.p[lay.ROWID, : self.num_rows]
-        sc = _i2f(self.p[lay.SCORE, : self.num_rows])
-        return jnp.zeros((self.num_rows,), jnp.float32).at[rowid].set(sc)
-
-    def rollback_last(self) -> bool:
-        """Undo the most recent tree's score contribution (the segment
-        layout still matches it — GBDT::RollbackOneIter)."""
-        if self._last_tree is None:
-            return False
-        delta = self._last_tree
-        lay = self.layout
-        sc = _i2f(self.p[lay.SCORE, : self.num_rows]) - delta
-        full = jnp.zeros((self.p.shape[1],), jnp.float32).at[: self.num_rows].set(sc)
-        self.p = self.p.at[lay.SCORE].set(_f2i(full))
-        self._last_tree = None
-        return True
-
-    # -- the fused chunk program --------------------------------------
     def _grad_fn(self, score, label, weight):
         obj = self.objective
         return obj.gradients_rowwise(score, label, weight if self.has_weights else None)
 
+    def _grad_all_fn(self, scores, label, weight):
+        """All K gradient planes at once from the score snapshot."""
+        obj = self.objective
+        return obj.gradients_rowwise_all(
+            scores, label, weight if self.has_weights else None
+        )
+
+    def _apply_delta(self, delta, k: int = 0) -> None:
+        """score channel k += delta (N,) — one in-place Pallas pass.
+        Gradient channels refresh at the next iteration's update pass, so
+        the cheap score-only streamer suffices here."""
+        if self._apply_prog is None:
+            self._apply_prog = {}
+        if k not in self._apply_prog:
+            lay = self.layout
+            interp = self.interpret
+
+            @jax.jit
+            def prog(p, delta):
+                return score_add(p, lay, delta, k, num_rows=self.num_rows,
+                                 interpret=interp)
+
+            self._apply_prog[k] = prog
+        self.p = self._apply_prog[k](self.p, jnp.asarray(delta, jnp.float32))
+
+    def add_score_constant(self, c: float) -> None:
+        self._apply_delta(jnp.full((self.num_rows,), np.float32(c)))
+
+    def sync_scores_from(self, scores_orig) -> None:
+        """Bring the score channels to an original-order (N,) / (K, N)
+        target (rare — init_model / external updates)."""
+        lay = self.layout
+        rowid = self.p[lay.ROWID, : self.num_rows]
+        target = np.atleast_2d(np.asarray(scores_orig, np.float32))
+        for k in range(self.K):
+            cur = _i2f(self.p[lay.SCORE + k, : self.num_rows])
+            tk = jnp.asarray(target[k])[rowid]
+            self._apply_delta(tk - cur, k=k)
+        self.score_dirty = False
+
+    def scores_original_order(self):
+        """(N,) for K == 1, else (K, N)."""
+        lay = self.layout
+        rowid = self.p[lay.ROWID, : self.num_rows]
+        outs = []
+        for k in range(self.K):
+            sc = _i2f(self.p[lay.SCORE + k, : self.num_rows])
+            outs.append(jnp.zeros((self.num_rows,), jnp.float32).at[rowid].set(sc))
+        return outs[0] if self.K == 1 else jnp.stack(outs)
+
+    def rollback_last(self) -> bool:
+        """Undo the most recent tree's score contribution (the segment
+        layout still matches it — GBDT::RollbackOneIter).  Multiclass
+        chunks track only the last class's delta, so they resync via
+        score_dirty instead."""
+        if self._last_tree is None or self.K != 1:
+            return False
+        self._apply_delta(-self._last_tree)
+        self._last_tree = None
+        return True
+
+    # -- the fused chunk program --------------------------------------
     def _build_program(self, T: int, bag_on: bool, bag_freq: int, used_features: int):
         lay = self.layout
         n = self.num_rows
         L = self.params.num_leaves
         F = self.params.num_features
+        K = self.K
         grad_fn = self._grad_fn
+        grad_all_fn = self._grad_all_fn
         params = self.params
         meta = self.meta
         hyper = self.hyper
         bmeta = self.bmeta
         interpret = self.interpret
         bag_frac = float(self.config.bagging_fraction)
+        G = params.num_cols or F
+        BH = params.num_bins_hist or params.num_bins
+        cfg = self.config
+        goss_on = (getattr(cfg, "boosting", "gbdt") == "goss") and K == 1
+        if goss_on:
+            top_cnt = max(1, int(n * float(cfg.top_rate)))
+            other_cnt = max(1, int(n * float(cfg.other_rate)))
+            goss_mult = float((n - top_cnt) / other_cnt)
+            goss_prob = float(other_cnt / max(n - top_cnt, 1))
+            goss_warm = int(1.0 / float(cfg.learning_rate))
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def prog(p, scratch, lr, key, iter0, t_run):
-            ones_sel = jnp.full((n,), np.float32(1.0).view(np.int32), jnp.int32)
-            pad = p.shape[1] - n
-
-            def row(x_i32):
-                return jnp.concatenate([x_i32, jnp.zeros((pad,), jnp.int32)])[None, :]
-
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def prog(p, lr, key, iter0, t_run):
             def one_iter(t, carry):
-                (p, scratch, recs, stopped, last_starts, last_cnts, last_vals, last_ns) = carry
+                (p, recs, stopped, delta) = carry
                 it = iter0 + t
-                # gradients from channels
-                score = _i2f(p[lay.SCORE, :n])
-                label = _i2f(p[lay.LABEL, :n])
-                weight = _i2f(p[lay.WEIGHT, :n])
-                g, h = grad_fn(score, label, weight)
                 if bag_on:
                     bkey = jax.random.fold_in(key, 2 * (it // bag_freq))
                     sel = jax.random.bernoulli(bkey, bag_frac, (n,)).astype(jnp.float32)
-                    sel_i = _f2i(sel)
                 else:
-                    sel_i = ones_sel
-                # rebuild P functionally (concat, not .at[row].set): row
-                # surgery on the 64 MB loop carry trips XLA's in-place
-                # elision and costs whole-array copies per write; a clean
-                # rebuild is one materialization (~0.2 ms)
-                p = jnp.concatenate(
-                    [p[: lay.G], row(_f2i(g)), row(_f2i(h)), row(sel_i), p[lay.SCORE :]],
-                    axis=0,
-                )
-
+                    sel = None
                 if used_features < F:
                     fkey = jax.random.fold_in(key, 2 * it + 1)
                     u = jax.random.uniform(fkey, (F,))
@@ -217,79 +274,137 @@ class PartitionedTrainer:
                 else:
                     fmask = jnp.ones((F,), jnp.float32)
 
-                tree, p, scratch = grow_tree_partitioned(
-                    p, scratch, fmask, meta, hyper, params, bmeta=bmeta,
-                    interpret=interpret,
-                )
+                ns_t = recs["num_splits"][t]
+                raw_t = recs["raw"][t]
+                if K == 1:
+                    if goss_on:
+                        # GOSS (goss.hpp:126-198): settle the pending
+                        # delta + fresh gradients first (histogram
+                        # discarded), score |g*h| on the fresh values,
+                        # keep exactly top_cnt rows + a Bernoulli sample
+                        # of the rest up-weighted into g/h, then the real
+                        # pass computes the root histogram of the
+                        # selected/scaled gradients.
+                        p, _ = update_and_root_hist(
+                            p, lay, grad_fn, delta=delta,
+                            num_rows=n, num_features=G, num_bins=BH,
+                            bits=params.bits, interpret=interpret,
+                        )
+                        gv = _i2f(p[lay.G, :n])
+                        hv = _i2f(p[lay.H, :n])
+                        gscore = jnp.abs(gv * hv)
+                        _, top_idx = jax.lax.top_k(gscore, top_cnt)
+                        is_top = jnp.zeros((n,), bool).at[top_idx].set(True)
+                        gkey = jax.random.fold_in(key, 3 * it + 2)
+                        sampled = (~is_top) & (
+                            jax.random.uniform(gkey, (n,)) < goss_prob
+                        )
+                        warm = it < goss_warm
+                        selv = jnp.where(
+                            warm, 1.0, (is_top | sampled).astype(jnp.float32)
+                        )
+                        mulv = jnp.where(warm | (~sampled), 1.0, goss_mult)
+                        p, root_hist = update_and_root_hist(
+                            p, lay, grad_fn, sel=selv, mul=mulv,
+                            num_rows=n, num_features=G, num_bins=BH,
+                            bits=params.bits, interpret=interpret,
+                        )
+                        delta = jnp.zeros((n,), jnp.float32)
+                    else:
+                        # in-place channel refresh (score += previous
+                        # tree's delta, new gradients, bagging select)
+                        # FUSED with the root histogram of the fresh
+                        # values — one pass.  The delta is PENDING from
+                        # the previous iteration: the row layout did not
+                        # change in between, so it applies against the
+                        # current partition order.
+                        p, root_hist = update_and_root_hist(
+                            p, lay, grad_fn, delta=delta, sel=sel,
+                            num_rows=n, num_features=G, num_bins=BH,
+                            bits=params.bits, interpret=interpret,
+                        )
+                    tree, p = grow_tree_partitioned(
+                        p, fmask, meta, hyper, params, bmeta=bmeta,
+                        interpret=interpret, root_hist=root_hist,
+                    )
+                    # score delta: +lr * leaf_value over each segment,
+                    # clamped like Tree.shrinkage (tree.h:13
+                    # kMaxTreeOutput) so training-time scores match the
+                    # stored model.  Once an iteration produces an empty
+                    # tree, training has logically stopped and later
+                    # in-program iterations must not touch the scores.
+                    keep = ((tree.num_splits > 0) & (~stopped)).astype(jnp.float32)
+                    lval = jnp.clip(lr * tree.leaf_value, -100.0, 100.0)
+                    delta = segment_values(tree, n, keep * lval)
+                    any_split = tree.num_splits > 0
+                    ns_t = ns_t.at[0].set(tree.num_splits)
+                    raw_t = raw_t.at[0].set(tree.recs_raw)
+                else:
+                    # K trees per iteration (per-class loop,
+                    # gbdt.cpp:445-480): ALL K gradient planes + K root
+                    # histograms from the same score snapshot in ONE
+                    # pass; each tree's delta lands on its score row
+                    # IMMEDIATELY after the tree (while its partition
+                    # layout is still current), which the precomputed
+                    # gradient planes make snapshot-safe.
+                    p, hists = update_multi_and_hists(
+                        p, lay, grad_all_fn, sel=sel, num_rows=n,
+                        num_features=G, num_bins=BH, bits=params.bits,
+                        interpret=interpret,
+                    )
+                    any_split = jnp.array(False)
+                    for k in range(K):
+                        tree, p = grow_tree_partitioned(
+                            p, fmask, meta, hyper, params, bmeta=bmeta,
+                            interpret=interpret, root_hist=hists[k],
+                            rows=lay.class_rows(k),
+                        )
+                        keep = ((tree.num_splits > 0) & (~stopped)).astype(jnp.float32)
+                        lval = jnp.clip(lr * tree.leaf_value, -100.0, 100.0)
+                        dk = segment_values(tree, n, keep * lval)
+                        p = score_add(p, lay, dk, k, num_rows=n,
+                                      interpret=interpret)
+                        any_split = any_split | (tree.num_splits > 0)
+                        ns_t = ns_t.at[k].set(tree.num_splits)
+                        raw_t = raw_t.at[k].set(tree.recs_raw)
+                    delta = delta  # unused for K > 1 (scores always settled)
 
-                # score update: +lr * leaf_value over each segment.  Once
-                # any iteration produces an empty tree, training has
-                # logically stopped (GBDT::TrainOneIter returns finished;
-                # the host truncates the records there) — later in-program
-                # iterations must not touch the scores either, or the
-                # channel would contain trees that are not in the model.
-                keep = ((tree.num_splits > 0) & (~stopped)).astype(jnp.float32)
-                # clamp like Tree.shrinkage (tree.h:13 kMaxTreeOutput): the
-                # persisted tree stores clip(lr*value, +-100), so the score
-                # channel must apply the same clip or training-time scores
-                # diverge from what the stored model predicts
-                lval = jnp.clip(lr * tree.leaf_value, -100.0, 100.0)
-                delta = segment_values(tree, n, keep * lval)
-                score2 = _i2f(p[lay.SCORE, :n]) + delta
-                p = jnp.concatenate(
-                    [p[: lay.SCORE], row(_f2i(score2)), p[lay.SCORE + 1 :]], axis=0
-                )
-
+                # ONE packed record buffer: per-op dispatch inside the
+                # loop costs ~1-2 us, so ten separate stores would be a
+                # measured ~10 ms/iter tax at 64 iters
                 recs = {
-                    "num_splits": recs["num_splits"].at[t].set(tree.num_splits),
-                    "leaf": recs["leaf"].at[t].set(tree.rec_leaf),
-                    "feat": recs["feat"].at[t].set(tree.rec_feat),
-                    "thr": recs["thr"].at[t].set(tree.rec_thr),
-                    "dbz": recs["dbz"].at[t].set(tree.rec_dbz),
-                    "gain": recs["gain"].at[t].set(tree.rec_gain),
-                    "lval": recs["lval"].at[t].set(tree.rec_lval),
-                    "rval": recs["rval"].at[t].set(tree.rec_rval),
-                    "lcnt": recs["lcnt"].at[t].set(tree.rec_lcnt),
-                    "rcnt": recs["rcnt"].at[t].set(tree.rec_rcnt),
-                    "ival": recs["ival"].at[t].set(tree.rec_internal_value),
+                    "num_splits": recs["num_splits"].at[t].set(ns_t),
+                    "raw": recs["raw"].at[t].set(raw_t),
                 }
-                kept = keep > 0
-                new_stopped = stopped | (tree.num_splits == 0)
-                pick = lambda a, b: jnp.where(kept, a, b)
-                return (p, scratch, recs, new_stopped,
-                        pick(tree.starts, last_starts), pick(tree.cnts, last_cnts),
-                        pick(keep * lval, last_vals),
-                        pick(tree.num_splits, last_ns))
+                new_stopped = stopped | (~any_split)
+                return (p, recs, new_stopped, delta)
 
             m = L - 1
             recs0 = {
-                "num_splits": jnp.zeros((T,), jnp.int32),
-                "leaf": jnp.zeros((T, m), jnp.int32),
-                "feat": jnp.zeros((T, m), jnp.int32),
-                "thr": jnp.zeros((T, m), jnp.int32),
-                "dbz": jnp.zeros((T, m), jnp.int32),
-                "gain": jnp.zeros((T, m)),
-                "lval": jnp.zeros((T, m)),
-                "rval": jnp.zeros((T, m)),
-                "lcnt": jnp.zeros((T, m)),
-                "rcnt": jnp.zeros((T, m)),
-                "ival": jnp.zeros((T, m)),
+                "num_splits": jnp.zeros((T, K), jnp.int32),
+                "raw": jnp.zeros((T, K, m, 12)),
             }
-            carry0 = (p, scratch, recs0, jnp.array(False),
-                      jnp.zeros((L,), jnp.int32),
-                      jnp.zeros((L,), jnp.int32), jnp.zeros((L,)), jnp.int32(0))
-            p, scratch, recs, _, ls, lc, lv, lns = jax.lax.fori_loop(
+            carry0 = (p, recs0, jnp.array(False), jnp.zeros((n,), jnp.float32))
+            p, recs, _, last_delta = jax.lax.fori_loop(
                 0, jnp.minimum(t_run, T), one_iter, carry0
             )
-            # original-order scores for eval (one scatter per chunk)
+            if K == 1:
+                # settle the last tree's delta into the channel so the
+                # score channel is consistent at chunk boundaries (the
+                # in-loop update applies tree t-1's delta at iteration t)
+                p, _ = update_and_root_hist(
+                    p, lay, grad_fn, delta=last_delta, num_rows=n,
+                    num_features=G, num_bins=BH,
+                    bits=params.bits, interpret=interpret,
+                )
+            # original-order scores for eval (K scatters per chunk)
             rowid = p[lay.ROWID, :n]
-            sc = _i2f(p[lay.SCORE, :n])
-            scores_orig = jnp.zeros((n,), jnp.float32).at[rowid].set(sc)
-            # last tree's per-position contribution (for rollback)
-            last_delta = segment_values(
-                types.SimpleNamespace(starts=ls, cnts=lc, num_splits=lns), n, lv
-            )
-            return p, scratch, recs, scores_orig, last_delta
+            outs = []
+            for k in range(K):
+                sc = _i2f(p[lay.SCORE + k, :n])
+                outs.append(jnp.zeros((n,), jnp.float32).at[rowid].set(sc))
+            scores_orig = outs[0] if K == 1 else jnp.stack(outs)
+            return p, recs, scores_orig, last_delta
 
         return prog
 
@@ -323,14 +438,14 @@ class PartitionedTrainer:
             return {}, self.scores_original_order(), 0
         while remaining > 0:
             step = min(remaining, alloc)
-            self.p, self.scratch, recs, scores_orig, last_delta = prog(
-                self.p, self.scratch, jnp.float32(lr), self._base_key,
+            self.p, recs, scores_orig, last_delta = prog(
+                self.p, jnp.float32(lr), self._base_key,
                 jnp.int32(iter0 + n_done), jnp.int32(step),
             )
             self._last_tree = last_delta
             part = jax.device_get(recs)
-            ns = part["num_splits"][:step]
-            stop = np.nonzero(ns == 0)[0]
+            ns = part["num_splits"][:step]  # (step, K)
+            stop = np.nonzero(np.all(ns == 0, axis=1))[0]
             done_here = int(stop[0]) if stop.size else step
             part = {k: v[:done_here] for k, v in part.items()}
             recs_np = part if recs_np is None else {
@@ -342,22 +457,393 @@ class PartitionedTrainer:
                 break
         return recs_np, scores_orig, n_done
 
-    def grow_result_view(self, recs_np, t):
-        """GrowResult-like view of tree t's records (Tree.from_grow_result
-        consumes exactly these fields)."""
+    def grow_result_view(self, recs_np, t, k: int = 0):
+        """GrowResult-like view of tree (t, class k)'s records
+        (Tree.from_grow_result consumes exactly these fields).  Unpacks
+        the (m, 12) raw record columns: [leaf, feat, thr, dbz, gain,
+        lval, rval, lcnt, rcnt, ival, 0, 0]."""
+        raw = recs_np["raw"][t][k]
         return types.SimpleNamespace(
-            num_splits=recs_np["num_splits"][t],
-            rec_leaf=recs_np["leaf"][t],
-            rec_feat=recs_np["feat"][t],
-            rec_thr=recs_np["thr"][t],
-            rec_dbz=recs_np["dbz"][t],
-            rec_gain=recs_np["gain"][t],
-            rec_lval=recs_np["lval"][t],
-            rec_rval=recs_np["rval"][t],
-            rec_lcnt=recs_np["lcnt"][t],
-            rec_rcnt=recs_np["rcnt"][t],
-            rec_internal_value=recs_np["ival"][t],
+            num_splits=recs_np["num_splits"][t][k],
+            rec_leaf=raw[:, 0].astype(np.int32),
+            rec_feat=raw[:, 1].astype(np.int32),
+            rec_thr=raw[:, 2].astype(np.int32),
+            rec_dbz=raw[:, 3].astype(np.int32),
+            rec_gain=raw[:, 4],
+            rec_lval=raw[:, 5],
+            rec_rval=raw[:, 6],
+            rec_lcnt=raw[:, 7],
+            rec_rcnt=raw[:, 8],
+            rec_internal_value=raw[:, 9],
         )
+
+
+class ShardedPartitionedTrainer(PartitionedTrainer):
+    """Data-parallel fused trainer: the partitioned fast path under
+    ``shard_map`` over a device mesh — DataParallelTreeLearner
+    (data_parallel_tree_learner.cpp:118-161) with split_stream kernels.
+
+    Rows are split into equal contiguous per-device shards, each with its
+    own packed matrix + BLK tail; child/root histograms are psum'd so
+    every device takes the bit-identical split on its local segment.
+    Grad/hess/scores stay device-resident across trees and chunks — no
+    per-tree host round-trips (the reference's per-iteration
+    ReduceScatter is the ONLY cross-device traffic, here one psum of the
+    (G, BH, 3) tensor per split)."""
+
+    def __init__(self, train_set, config, objective, meta, hyper, mesh):
+        import jax as _jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        binned = train_set.binned
+        n, f = binned.shape
+        md = train_set.metadata
+        self.has_weights = md.weights is not None
+        self.mesh = mesh
+        d = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+        self.d = d
+        nproc = _jax.process_count()
+        d_local = d // max(nproc, 1)
+        # uniform shard length across ALL processes
+        if nproc > 1:
+            from jax.experimental import multihost_utils
+
+            counts = np.asarray(multihost_utils.process_allgather(np.asarray(n)))
+            per_proc = int(counts.max())
+        else:
+            per_proc = n
+        nl = -(-per_proc // d_local)
+        self.num_rows = nl  # per-shard rows (the grower's n)
+        self.local_rows = n  # this process's real rows
+        self.d_local = d_local
+
+        bundle = getattr(train_set, "bundle", None)
+        self.bmeta = None
+        num_cols, num_bins_hist = 0, 0
+        if bundle is not None and train_set.bundled is not None:
+            matrix = np.asarray(train_set.bundled)
+            num_cols = bundle.num_cols
+            num_bins_hist = int(bundle.max_col_bin)
+            self.bmeta = _build_bundle_meta(bundle, train_set, int(train_set.max_num_bin))
+            max_col_bin = num_bins_hist
+        else:
+            matrix = np.asarray(binned)
+            max_col_bin = int(train_set.max_num_bin)
+        force_bits = os.environ.get("LIGHTGBM_TPU_FORCE_BITS", "")
+        bits = 4 if max_col_bin <= 16 else 8
+        if force_bits in ("4", "8"):
+            bits = int(force_bits)
+            if bits == 4 and max_col_bin > 16:
+                bits = 8
+        self.layout = PLayout(matrix.shape[1], num_score=1, with_weight=True, bits=bits)
+
+        from ..ops.pkernels import BLK, pack_matrix
+
+        label = np.asarray(md.label, np.float32)
+        weight = (np.asarray(md.weights, np.float32)
+                  if self.has_weights else np.ones(n, np.float32))
+        shards = []
+        for k in range(d_local):
+            lo, hi = k * nl, min((k + 1) * nl, n)
+            nreal = max(0, hi - lo)
+            mb = np.zeros((nl, matrix.shape[1]), np.uint8)
+            lb = np.zeros((nl,), np.float32)
+            wb = np.zeros((nl,), np.float32)
+            if nreal:
+                mb[:nreal] = matrix[lo:hi]
+                lb[:nreal] = label[lo:hi]
+                wb[:nreal] = weight[lo:hi]
+            shards.append(np.asarray(
+                pack_matrix(mb, self.layout, label=lb, weight=wb, num_real=nreal)
+            ))
+        local = np.stack(shards)  # (d_local, C, nl + BLK)
+        sharding = NamedSharding(mesh, P("data"))
+        if nproc > 1:
+            gshape = (d, local.shape[1], local.shape[2])
+            bufs = [
+                _jax.device_put(local[i], dev)
+                for i, dev in enumerate(mesh.local_devices)
+            ]
+            self.p = _jax.make_array_from_single_device_arrays(gshape, sharding, bufs)
+        else:
+            self.p = _jax.device_put(jnp.asarray(local), sharding)
+
+        self.K = 1  # sharded fast path is single-class (multiclass
+        #             data-parallel keeps the mask grower)
+        self.meta = meta
+        self.hyper = hyper
+        self.objective = objective
+        self.config = config
+        self.params = PGrowParams(
+            num_leaves=max(2, int(config.num_leaves)),
+            num_bins=int(train_set.max_num_bin),
+            num_features=f,
+            num_rows=nl,
+            max_depth=int(config.max_depth),
+            use_missing=bool(config.use_missing),
+            has_categorical=bool(np.any(np.asarray(meta.is_categorical))),
+            num_cols=num_cols,
+            num_bins_hist=num_bins_hist,
+            bits=bits,
+            axis_name="data",
+        )
+        self.interpret = _jax.default_backend() != "tpu"
+        self.score_dirty = True
+        self._progs = {}
+        self._apply_prog = None
+        self._scores_prog = None
+        self._last_tree = None
+        self._base_key = jax.random.PRNGKey(
+            (int(config.bagging_seed) << 1) ^ int(config.feature_fraction_seed)
+        )
+
+    # ------------------------------------------------------------------
+    def _shard_map(self, fn, in_specs, out_specs):
+        from ..parallel.learner import _shard_map_compat
+
+        return _shard_map_compat(fn, self.mesh, in_specs, out_specs)
+
+    def _pad_local(self, vec):
+        """Process-local (n,) row vector -> (d_local * nl,) shard-padded."""
+        v = np.zeros((self.d_local * self.num_rows,), np.float32)
+        vv = np.asarray(vec, np.float32)
+        nl = self.num_rows
+        for k in range(self.d_local):
+            lo, hi = k * nl, min((k + 1) * nl, self.local_rows)
+            if hi > lo:
+                v[k * nl : k * nl + (hi - lo)] = vv[lo:hi]
+        return v
+
+    def _make_row_global(self, vec):
+        """Shard-padded local vector -> global (d * nl,) row-sharded array."""
+        import jax as _jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        nl = self.num_rows
+        local = self._pad_local(vec).reshape(self.d_local, nl)
+        sharding = NamedSharding(self.mesh, P("data"))
+        if _jax.process_count() > 1:
+            gshape = (self.d * nl,)
+            bufs = [_jax.device_put(local[i], dev)
+                    for i, dev in enumerate(self.mesh.local_devices)]
+            return _jax.make_array_from_single_device_arrays(gshape, sharding, bufs)
+        return _jax.device_put(jnp.asarray(local.reshape(-1)), sharding)
+
+    def _gather_rows(self, garr):
+        """Global (d * nl,) row-sharded array -> process-local (n,) numpy."""
+        import jax as _jax
+
+        if _jax.process_count() > 1:
+            shards = sorted(garr.addressable_shards,
+                            key=lambda s: (s.index[0].start or 0))
+            local = np.concatenate([np.asarray(s.data) for s in shards])
+        else:
+            local = np.asarray(garr)
+        nl = self.num_rows
+        parts = []
+        for k in range(self.d_local):
+            lo, hi = k * nl, min((k + 1) * nl, self.local_rows)
+            parts.append(local[k * nl : k * nl + max(0, hi - lo)])
+        return np.concatenate(parts) if parts else local[:0]
+
+    def _apply_delta(self, delta) -> None:
+        """delta in process-row order (n,); applied per shard in place."""
+        from jax.sharding import PartitionSpec as P
+
+        if self._apply_prog is None:
+            lay = self.layout
+            interp = self.interpret
+            params = self.params
+            nl = self.num_rows
+
+            def shard_body(pg, dg):
+                p, _ = update_and_root_hist(
+                    pg[0], lay, self._grad_fn, delta=dg, num_rows=nl,
+                    num_features=(params.num_cols or params.num_features),
+                    num_bins=(params.num_bins_hist or params.num_bins),
+                    bits=params.bits, interpret=interp,
+                )
+                return p[None]
+
+            self._apply_prog = jax.jit(
+                self._shard_map(shard_body, (P("data"), P("data")), P("data"))
+            )
+        dg = delta if hasattr(delta, "sharding") else self._make_row_global(delta)
+        self.p = self._apply_prog(self.p, dg)
+
+    def add_score_constant(self, c: float) -> None:
+        # constant only on REAL rows (padding rows' scores are unused)
+        self._apply_delta(np.full((self.local_rows,), np.float32(c)))
+
+    def sync_scores_from(self, scores_orig) -> None:
+        cur = self._gather_rows(self._scores_global())
+        target = np.asarray(scores_orig, np.float32)
+        self._apply_delta(target - cur)
+        self.score_dirty = False
+
+    def _scores_global(self):
+        from jax.sharding import PartitionSpec as P
+
+        if self._scores_prog is None:
+            lay = self.layout
+            nl = self.num_rows
+
+            def shard_body(pg):
+                p = pg[0]
+                rowid = p[lay.ROWID, :nl]
+                sc = _i2f(p[lay.SCORE, :nl])
+                return jnp.zeros((nl,), jnp.float32).at[rowid].set(sc)
+
+            self._scores_prog = jax.jit(
+                self._shard_map(shard_body, (P("data"),), P("data"))
+            )
+        return self._scores_prog(self.p)
+
+    def scores_original_order(self):
+        return jnp.asarray(self._gather_rows(self._scores_global()))
+
+    def rollback_last(self) -> bool:
+        if self._last_tree is None:
+            return False
+        import jax as _jax
+
+        neg = _jax.jit(lambda x: -x)(self._last_tree)
+        self._apply_delta(neg)
+        self._last_tree = None
+        return True
+
+    # ------------------------------------------------------------------
+    def _build_program(self, T: int, bag_on: bool, bag_freq: int, used_features: int):
+        from jax.sharding import PartitionSpec as P
+
+        lay = self.layout
+        nl = self.num_rows
+        L = self.params.num_leaves
+        F = self.params.num_features
+        grad_fn = self._grad_fn
+        params = self.params
+        meta = self.meta
+        hyper = self.hyper
+        bmeta = self.bmeta
+        interpret = self.interpret
+        bag_frac = float(self.config.bagging_fraction)
+        G = params.num_cols or F
+        BH = params.num_bins_hist or params.num_bins
+
+        def shard_body(pg, valid, lr, key, iter0, t_run):
+            p = pg[0]
+            ax = jax.lax.axis_index("data")
+
+            def one_iter(t, carry):
+                (p, recs, stopped, delta) = carry
+                it = iter0 + t
+                if bag_on:
+                    bkey = jax.random.fold_in(
+                        jax.random.fold_in(key, 2 * (it // bag_freq)), ax
+                    )
+                    sel = jax.random.bernoulli(bkey, bag_frac, (nl,)).astype(jnp.float32)
+                    sel = sel * valid  # shard-padding rows stay deselected
+                else:
+                    sel = None
+                p, root_hist = update_and_root_hist(
+                    p, lay, grad_fn, delta=delta, sel=sel, num_rows=nl,
+                    num_features=G, num_bins=BH, bits=params.bits,
+                    interpret=interpret,
+                )
+                root_hist = jax.lax.psum(root_hist, "data")
+
+                if used_features < F:
+                    fkey = jax.random.fold_in(key, 2 * it + 1)
+                    u = jax.random.uniform(fkey, (F,))
+                    _, idx = jax.lax.top_k(u, used_features)
+                    fmask = jnp.zeros((F,), jnp.float32).at[idx].set(1.0)
+                else:
+                    fmask = jnp.ones((F,), jnp.float32)
+
+                tree, p = grow_tree_partitioned(
+                    p, fmask, meta, hyper, params, bmeta=bmeta,
+                    interpret=interpret, root_hist=root_hist,
+                )
+
+                keep = ((tree.num_splits > 0) & (~stopped)).astype(jnp.float32)
+                lval = jnp.clip(lr * tree.leaf_value, -100.0, 100.0)
+                delta_next = segment_values(tree, nl, keep * lval)
+                recs = {
+                    "num_splits": recs["num_splits"].at[t, 0].set(tree.num_splits),
+                    "raw": recs["raw"].at[t, 0].set(tree.recs_raw),
+                }
+                new_stopped = stopped | (tree.num_splits == 0)
+                return (p, recs, new_stopped, delta_next)
+
+            m = L - 1
+            recs0 = {
+                "num_splits": jnp.zeros((T, 1), jnp.int32),
+                "raw": jnp.zeros((T, 1, m, 12)),
+            }
+            carry0 = (p, recs0, jnp.array(False), jnp.zeros((nl,), jnp.float32))
+            p, recs, _, last_delta = jax.lax.fori_loop(
+                0, jnp.minimum(t_run, T), one_iter, carry0
+            )
+            p, _ = update_and_root_hist(
+                p, lay, grad_fn, delta=last_delta, num_rows=nl,
+                num_features=G, num_bins=BH, bits=params.bits,
+                interpret=interpret,
+            )
+            rowid = p[lay.ROWID, :nl]
+            sc = _i2f(p[lay.SCORE, :nl])
+            scores_local = jnp.zeros((nl,), jnp.float32).at[rowid].set(sc)
+            return p[None], recs, scores_local, last_delta
+
+        mapped = self._shard_map(
+            shard_body,
+            (P("data"), P("data"), P(), P(), P(), P()),
+            (P("data"), {"num_splits": P(), "raw": P()}, P("data"), P("data")),
+        )
+        return jax.jit(mapped, donate_argnums=(0,))
+
+    def train_chunk(self, T: int, lr: float, iter0: int):
+        cfg = self.config
+        bag_on = cfg.bagging_fraction < 1.0 and cfg.bagging_freq > 0
+        bag_freq = max(1, int(cfg.bagging_freq))
+        used_features = self.params.num_features
+        if cfg.feature_fraction < 1.0:
+            used_features = max(1, int(self.params.num_features * cfg.feature_fraction))
+        alloc = self.CHUNK_ALLOC
+        pkey = (alloc, bag_on, bag_freq, used_features)
+        if pkey not in self._progs:
+            self._progs[pkey] = self._build_program(alloc, bag_on, bag_freq, used_features)
+        prog = self._progs[pkey]
+        recs_np = None
+        n_done = 0
+        remaining = T
+        scores = None
+        if T <= 0:
+            return {}, self.scores_original_order(), 0
+        if not hasattr(self, "_valid_global"):
+            self._valid_global = self._make_row_global(
+                np.ones((self.local_rows,), np.float32)
+            )
+        while remaining > 0:
+            step = min(remaining, alloc)
+            self.p, recs, scores, last_delta = prog(
+                self.p, self._valid_global, jnp.float32(lr), self._base_key,
+                jnp.int32(iter0 + n_done), jnp.int32(step),
+            )
+            self._last_tree = last_delta
+            part = jax.device_get(recs)
+            ns = part["num_splits"][:step]  # (step, 1)
+            stop = np.nonzero(np.all(ns == 0, axis=1))[0]
+            done_here = int(stop[0]) if stop.size else step
+            part = {k: v[:done_here] for k, v in part.items()}
+            recs_np = part if recs_np is None else {
+                k: np.concatenate([recs_np[k], part[k]]) for k in part
+            }
+            n_done += done_here
+            remaining -= step
+            if done_here < step:
+                break
+        scores_orig = jnp.asarray(self._gather_rows(scores))
+        return recs_np, scores_orig, n_done
 
 
 def eligible(config, train_set, objective, num_tree_per_iteration: int) -> bool:
@@ -368,11 +854,22 @@ def eligible(config, train_set, objective, num_tree_per_iteration: int) -> bool:
         return False
     if flag != "force" and jax.default_backend() != "tpu":
         return False
-    if objective is None or num_tree_per_iteration != 1:
+    if objective is None:
         return False
-    if not getattr(objective, "rowwise", False):
-        return False
-    if config.tree_learner != "serial":
+    if num_tree_per_iteration == 1:
+        if not getattr(objective, "rowwise", False):
+            return False
+    else:
+        # multiclass: needs the all-classes row-local gradient plane
+        # (gradients_rowwise_all); 6K+1 bf16 value rows must fit the
+        # MXU's 128 sublanes in the fused update kernel
+        if not getattr(objective, "rowwise_multi", False):
+            return False
+        if num_tree_per_iteration > 16:
+            return False
+    # serial -> PartitionedTrainer; data -> ShardedPartitionedTrainer
+    # (feature/voting keep the mask grower's collective formulations)
+    if config.tree_learner not in ("serial", "data"):
         return False
     if np.asarray(train_set.binned).dtype != np.uint8:
         return False

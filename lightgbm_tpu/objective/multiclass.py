@@ -17,6 +17,10 @@ from .binary import BinaryLogloss
 
 class MulticlassSoftmax(ObjectiveFunction):
     name = "multiclass"
+    # per-class row-local gradients (gradients_rowwise_class): the fused
+    # partitioned trainer can drive K trees/iteration from the packed
+    # matrix's K score channels (GBDT per-class loop, gbdt.cpp:445-480)
+    rowwise_multi = True
 
     def __init__(self, config):
         self.num_class = int(config.num_class)
@@ -47,6 +51,22 @@ class MulticlassSoftmax(ObjectiveFunction):
             hess = hess * self.weights[None, :]
         return grad, hess
 
+    def gradients_rowwise_all(self, scores, label, weight):
+        """All K gradient planes from the score rows in ARBITRARY row
+        order (the partitioned trainer's channels): scores (K, n), label
+        the raw class index; returns ((K, n), (K, n))."""
+        p = jnp.exp(scores - jnp.max(scores, axis=0, keepdims=True))
+        p = p / jnp.sum(p, axis=0, keepdims=True)
+        classes = jnp.arange(self.num_class, dtype=jnp.float32)
+        onehot = (label.reshape(1, -1) == classes[:, None]).astype(jnp.float32)
+        onehot = onehot.reshape(p.shape)
+        grad = p - onehot
+        hess = 2.0 * p * (1.0 - p)
+        if weight is not None:
+            grad = grad * weight
+            hess = hess * weight
+        return grad, hess
+
     def convert_output(self, score):
         p = jnp.exp(score - jnp.max(score, axis=0, keepdims=True))
         return p / jnp.sum(p, axis=0, keepdims=True)
@@ -68,6 +88,7 @@ class MulticlassOVA(ObjectiveFunction):
     (multiclass_objective.hpp:139-225)."""
 
     name = "multiclassova"
+    rowwise_multi = True
 
     def __init__(self, config):
         self.num_class = int(config.num_class)
@@ -86,6 +107,17 @@ class MulticlassOVA(ObjectiveFunction):
         outs = [self.binary[k].get_gradients(score[k]) for k in range(self.num_class)]
         grad = jnp.stack([g for g, _ in outs])
         hess = jnp.stack([h for _, h in outs])
+        return grad, hess
+
+    def gradients_rowwise_all(self, scores, label, weight):
+        # the raw class-index label goes through: binary[k]'s is_pos
+        # closure tests ``label == k`` itself
+        outs = [
+            self.binary[k].gradients_rowwise(scores[k : k + 1], label, weight)
+            for k in range(self.num_class)
+        ]
+        grad = jnp.concatenate([g for g, _ in outs], axis=0)
+        hess = jnp.concatenate([h for _, h in outs], axis=0)
         return grad, hess
 
     def convert_output(self, score):

@@ -4,19 +4,29 @@ Counterpart of SerialTreeLearner::Train + DataPartition
 (src/treelearner/serial_tree_learner.cpp:152-207, data_partition.hpp) with
 the reference's asymptotics restored on TPU: rows live physically
 partitioned by leaf inside the packed (C, N) matrix of ops/pkernels.py,
-so each split costs O(parent segment) streaming (partition) plus
-O(smaller child) histogram work — not O(N) — and the whole tree grows
-inside ONE XLA program (a lax.while_loop over best-first splits, ~3 us
-kernel dispatch per split, zero host round-trips).
+so each split costs ONE streaming pass over the parent segment
+(``split_stream``: two-ended in-place partition + BOTH children's
+histograms in the same pass) — not O(N) — and the whole tree grows
+inside ONE XLA program (a lax.while_loop over best-first splits).
 
 vs ops/grow.py (the mask-based single-program grower): that pays a full
 O(N) masked pass per split (~10 ms at 1M rows -> 2.5 s per 255-leaf
-tree).  This grower runs the same tree in ~40 ms.  grow.py remains the
-shard_map-distributed path (collectives) and the small-data path.
+tree).  This grower runs the same tree in tens of ms.  grow.py remains
+the shard_map-distributed path (collectives) and the small-data path.
 
-The histogram subtraction trick (FeatureHistogram::Subtract,
-feature_histogram.hpp:63) carries over unchanged: only the child with
-fewer physical rows is streamed; the sibling is parent - smaller.
+Design notes (v2, measured on v5e):
+- The reference's histogram-subtraction trick
+  (FeatureHistogram::Subtract, feature_histogram.hpp:63) is SUPERSEDED:
+  both children's histograms fall out of the partition pass for free
+  (the bin one-hots — the VPU-bound cost — are shared, and the value
+  rows just widen 7->14 MXU sublanes), so the (L, F, B, 3) histogram
+  pool and its per-split updates are gone entirely.
+- Per-split bookkeeping is packed into FOUR wide arrays (seg/bs/leaf/
+  recs) updated with one scatter each: per-op dispatch inside a TPU
+  while_loop body costs ~1-2 us, so the old ~25 small updates were a
+  measured ~150 us/split tax.
+- Left/right split search runs as ONE vmapped call over the stacked
+  (2, F, B, 3) children histograms.
 """
 
 from __future__ import annotations
@@ -27,7 +37,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from .pkernels import BLK, PLayout, hist_dyn, partition_segment
+from .pkernels import BLK, PLayout, hist_dyn, split_stream
 from .split import (
     NEG_INF,
     FeatureMeta,
@@ -55,6 +65,12 @@ class PGrowParams(NamedTuple):
     # bin word width: 4 (Dense4bitsBin form, 8 bins/word) when every
     # column fits 16 bins, else 8
     bits: int = 8
+    # data-parallel mode: shard_map mesh axis to psum histograms over
+    # (DataParallelTreeLearner, data_parallel_tree_learner.cpp:148-161 —
+    # the ReduceScatter of local histograms becomes one psum; every
+    # device then takes the identical best split on its local segment).
+    # None/"" = serial.
+    axis_name: str = None
 
 
 class BundleMeta(NamedTuple):
@@ -93,6 +109,9 @@ class PTreeResult(NamedTuple):
     cnts: jnp.ndarray  # (L,) int32 physical rows per leaf
     leaf_value: jnp.ndarray  # (L,) raw (pre-shrinkage) outputs
     leaf_cnt: jnp.ndarray  # (L,) f32 selected counts
+    recs_raw: jnp.ndarray  # (L-1, 12) f32 packed split records (the
+    #   rec_* views below are slices of this; consumers inside fused
+    #   loops should store recs_raw whole — one buffer update, not ten)
     rec_leaf: jnp.ndarray
     rec_feat: jnp.ndarray
     rec_thr: jnp.ndarray
@@ -107,61 +126,52 @@ class PTreeResult(NamedTuple):
 
 class _PState(NamedTuple):
     p: jnp.ndarray
-    scratch: jnp.ndarray
     num_splits: jnp.ndarray
     done: jnp.ndarray
-    starts: jnp.ndarray
-    cnts: jnp.ndarray
-    pool: jnp.ndarray  # (L, F, B, 3)
-    bs_gain: jnp.ndarray
-    bs_feat: jnp.ndarray
-    bs_thr: jnp.ndarray
-    bs_dbz: jnp.ndarray
-    bs_left: jnp.ndarray  # (L, 3)
-    leaf_sum: jnp.ndarray  # (L, 3)
-    leaf_value: jnp.ndarray
-    leaf_cnt: jnp.ndarray
-    leaf_depth: jnp.ndarray
-    rec_leaf: jnp.ndarray
-    rec_feat: jnp.ndarray
-    rec_thr: jnp.ndarray
-    rec_dbz: jnp.ndarray
-    rec_gain: jnp.ndarray
-    rec_lval: jnp.ndarray
-    rec_rval: jnp.ndarray
-    rec_lcnt: jnp.ndarray
-    rec_rcnt: jnp.ndarray
-    rec_internal_value: jnp.ndarray
+    seg: jnp.ndarray  # (L, 2) i32 [start, cnt]
+    bs: jnp.ndarray  # (L, 8) f32 [gain, feat, thr, dbz, lg, lh, lc, 0]
+    leaf: jnp.ndarray  # (L, 8) f32 [sum_g, sum_h, sum_c, value, cnt, depth, 0, 0]
+    recs: jnp.ndarray  # (L-1, 12) f32 [leaf, feat, thr, dbz, gain, lval,
+    #                                   rval, lcnt, rcnt, ival, 0, 0]
 
 
-def _store_split(st: _PState, leaf, res) -> _PState:
-    return st._replace(
-        bs_gain=st.bs_gain.at[leaf].set(res.gain),
-        bs_feat=st.bs_feat.at[leaf].set(res.feature),
-        bs_thr=st.bs_thr.at[leaf].set(res.threshold_bin),
-        bs_dbz=st.bs_dbz.at[leaf].set(res.default_bin_for_zero),
-        bs_left=st.bs_left.at[leaf].set(
-            jnp.stack([res.left_sum_g, res.left_sum_h, res.left_cnt])
-        ),
-    )
+def _meta_table(meta: FeatureMeta, bmeta, f: int, bits: int) -> jnp.ndarray:
+    """(F, 8) f32 per-feature lookup (one gather per split instead of
+    six): [default_bin, is_cat, col, off_lo, off_hi, bias, 0, 0].
+    Integer values < 2^24 are exact in f32."""
+    db = meta.default_bin.astype(jnp.float32)
+    cat = meta.is_categorical.astype(jnp.float32)
+    if bmeta is not None:
+        col = bmeta.col.astype(jnp.float32)
+        off_lo = bmeta.off_lo.astype(jnp.float32)
+        off_hi = bmeta.off_hi.astype(jnp.float32)
+        bias = bmeta.bias.astype(jnp.float32)
+    else:
+        col = jnp.arange(f, dtype=jnp.float32)
+        off_lo = jnp.zeros((f,), jnp.float32)
+        off_hi = jnp.full((f,), float(1 << bits), jnp.float32)
+        bias = jnp.zeros((f,), jnp.float32)
+    z = jnp.zeros((f,), jnp.float32)
+    return jnp.stack([db, cat, col, off_lo, off_hi, bias, z, z], axis=1)
 
 
-@functools.partial(jax.jit, static_argnames=("params", "interpret"))
+@functools.partial(jax.jit, static_argnames=("params", "interpret", "rows"))
 def grow_tree_partitioned(
     p: jnp.ndarray,
-    scratch: jnp.ndarray,
     feature_mask: jnp.ndarray,
     meta: FeatureMeta,
     hyper: SplitHyper,
     params: PGrowParams,
     bmeta: BundleMeta = None,
     interpret: bool = False,
+    root_hist: jnp.ndarray = None,
+    rows: tuple = None,
 ):
     """Grow one leaf-wise tree over the partitioned matrix.
 
-    Returns (PTreeResult, p', scratch').  ``p`` arrives with the g/h/sel
-    channels freshly written for this tree; row ORDER is whatever the
-    previous tree left (irrelevant — the root segment is always the full
+    Returns (PTreeResult, p').  ``p`` arrives with the g/h/sel channels
+    freshly written for this tree; row ORDER is whatever the previous
+    tree left (irrelevant — the root segment is always the full
     [0, num_rows) range and histograms are order-invariant)."""
     L = params.num_leaves
     F = params.num_features
@@ -171,180 +181,190 @@ def grow_tree_partitioned(
     G = params.num_cols or F
     BH = params.num_bins_hist or B
     bundled = bmeta is not None
+    if rows is None:
+        # default single-class channel rows; multiclass callers pass
+        # PLayout.class_rows(k) so tree k reads its own g/h pair
+        rows = PLayout(G, bits=params.bits).rows
+    per = 32 // params.bits
+    mtab = _meta_table(meta, bmeta, F, params.bits)
 
-    def find_best(hist, sums, depth_ok):
-        sg, sh, sc = sums[0], sums[1], sums[2]
+    def find2(hist2, sums2, depth_ok):
+        """Best split for two sibling leaves at once: hist2 (2, G/F, B, 3),
+        sums2 (2, 3) -> per-leaf scalars stacked on axis 0."""
         if bundled:
-            hist = _expand_bundle_hist(hist, sums, bmeta, F, B)
-        gain_f, thr_f, dbz_f, left_f = best_split_per_feature(
-            hist, sg, sh, sc, meta, hyper, feature_mask, params.use_missing,
-            has_categorical=params.has_categorical,
-        )
-        res = finalize_split(gain_f, thr_f, dbz_f, left_f, sg, sh, sc, hyper)
+            hist2 = jax.vmap(
+                lambda hh, ss: _expand_bundle_hist(hh, ss, bmeta, F, B)
+            )(hist2, sums2)
+
+        def one(hist, s):
+            gain_f, thr_f, dbz_f, left_f = best_split_per_feature(
+                hist, s[0], s[1], s[2], meta, hyper, feature_mask,
+                params.use_missing, has_categorical=params.has_categorical,
+            )
+            return finalize_split(gain_f, thr_f, dbz_f, left_f, s[0], s[1], s[2], hyper)
+
+        res = jax.vmap(one)(hist2, sums2)
         return res._replace(gain=jnp.where(depth_ok, res.gain, NEG_INF))
 
-    root_hist = hist_dyn(p, 0, n, G, BH, bits=params.bits, interpret=interpret)
+    if root_hist is None:
+        root_hist = hist_dyn(p, 0, n, G, BH, bits=params.bits, rows=rows,
+                             interpret=interpret)
+        if params.axis_name:
+            root_hist = jax.lax.psum(root_hist, params.axis_name)
+    # (callers passing root_hist in data-parallel mode psum it themselves)
     root_sums = jnp.sum(root_hist[0], axis=0)  # (3,): totals via feature 0
-    root_res = find_best(root_hist, root_sums, jnp.array(True))
+    rr = find2(jnp.stack([root_hist, root_hist]),
+               jnp.stack([root_sums, root_sums]), jnp.array(True))
 
-    zi = jnp.zeros((L,), jnp.int32)
-    zf = jnp.zeros((L,))
-    zr = jnp.zeros((L - 1,))
-    zri = jnp.zeros((L - 1,), jnp.int32)
+    root_val = leaf_output(root_sums[0], root_sums[1], hyper.lambda_l1, hyper.lambda_l2)
+    seg0 = jnp.zeros((L, 2), jnp.int32).at[0, 1].set(n)
+    bs0 = jnp.full((L, 8), NEG_INF, jnp.float32).at[0].set(
+        jnp.stack([rr.gain[0], rr.feature[0].astype(jnp.float32),
+                   rr.threshold_bin[0].astype(jnp.float32),
+                   rr.default_bin_for_zero[0].astype(jnp.float32),
+                   rr.left_sum_g[0], rr.left_sum_h[0], rr.left_cnt[0],
+                   jnp.float32(0.0)])
+    )
+    leaf0 = jnp.zeros((L, 8), jnp.float32).at[0].set(
+        jnp.stack([root_sums[0], root_sums[1], root_sums[2], root_val,
+                   root_sums[2], jnp.float32(0.0), jnp.float32(0.0),
+                   jnp.float32(0.0)])
+    )
     st = _PState(
         p=p,
-        scratch=scratch,
         num_splits=jnp.int32(0),
         done=jnp.array(False),
-        starts=zi,
-        cnts=zi.at[0].set(n),
-        pool=jnp.zeros((L, G, BH, 3)).at[0].set(root_hist),
-        bs_gain=jnp.full((L,), NEG_INF),
-        bs_feat=zi,
-        bs_thr=zi,
-        bs_dbz=zi,
-        bs_left=jnp.zeros((L, 3)),
-        leaf_sum=jnp.zeros((L, 3)).at[0].set(root_sums),
-        leaf_value=zf.at[0].set(
-            leaf_output(root_sums[0], root_sums[1], hyper.lambda_l1, hyper.lambda_l2)
-        ),
-        leaf_cnt=zf.at[0].set(root_sums[2]),
-        leaf_depth=zi,
-        rec_leaf=zri, rec_feat=zri, rec_thr=zri, rec_dbz=zri,
-        rec_gain=zr, rec_lval=zr, rec_rval=zr, rec_lcnt=zr, rec_rcnt=zr,
-        rec_internal_value=zr,
+        seg=seg0,
+        bs=bs0,
+        leaf=leaf0,
+        recs=jnp.zeros((L - 1, 12), jnp.float32),
     )
-    st = _store_split(st, 0, root_res)
 
     def cond(st: _PState):
         return (~st.done) & (st.num_splits < L - 1)
 
     def body(st: _PState):
-        gain = jnp.max(st.bs_gain)
+        gain = jnp.max(st.bs[:, 0])
         return jax.lax.cond(gain > 0.0, _split, lambda s: s._replace(done=True), st)
 
     def _split(st: _PState):
         s = st.num_splits
-        bl = jnp.argmax(st.bs_gain).astype(jnp.int32)
-        right_leaf = (s + 1).astype(jnp.int32)
+        bl = jnp.argmax(st.bs[:, 0]).astype(jnp.int32)
+        rl = (s + 1).astype(jnp.int32)
 
-        feat = st.bs_feat[bl]
-        thr = st.bs_thr[bl]
-        dbz = st.bs_dbz[bl]
-        gain = st.bs_gain[bl]
-        start = st.starts[bl]
-        cnt = st.cnts[bl]
-        zb = meta.default_bin[feat]
-        cat = meta.is_categorical[feat].astype(jnp.int32)
-        if bundled:
-            colidx = bmeta.col[feat]
-            off_lo, off_hi, bias = bmeta.off_lo[feat], bmeta.off_hi[feat], bmeta.bias[feat]
-        else:
-            colidx = feat
-            off_lo, off_hi, bias = jnp.int32(0), jnp.int32(256), jnp.int32(0)
+        bsrow = st.bs[bl]
+        gain = bsrow[0]
+        feat = bsrow[1].astype(jnp.int32)
+        thr = bsrow[2].astype(jnp.int32)
+        dbz = bsrow[3].astype(jnp.int32)
+        left = bsrow[4:7]
+        leafrow = st.leaf[bl]
+        totals = leafrow[0:3]
+        pval = leafrow[3]
+        child_depth = leafrow[5] + 1.0
+        segrow = st.seg[bl]
+        start = segrow[0]
+        cnt = segrow[1]
+        mrow = mtab[feat]
+        zb = mrow[0].astype(jnp.int32)
+        cat = mrow[1].astype(jnp.int32)
+        colidx = mrow[2].astype(jnp.int32)
+        off_lo = mrow[3].astype(jnp.int32)
+        off_hi = mrow[4].astype(jnp.int32)
+        bias = mrow[5].astype(jnp.int32)
 
-        per = 32 // params.bits
-        p, scratch, nl = partition_segment(
-            st.p, st.scratch, start, cnt,
+        p, nl, lhist, rhist = split_stream(
+            st.p, start, cnt,
             colidx // per, (colidx % per) * params.bits, zb, dbz, thr, cat,
             off_lo=off_lo, off_hi=off_hi, bias=bias,
-            bits=params.bits, interpret=interpret,
+            num_features=G, num_bins=BH, bits=params.bits, rows=rows,
+            interpret=interpret,
         )
+        hist2 = jnp.stack([lhist, rhist])
+        if params.axis_name:
+            # global children histograms; the split decision below is then
+            # bit-identical on every device (local segments diverge, the
+            # tree does not)
+            hist2 = jax.lax.psum(hist2, params.axis_name)
 
-        left = st.bs_left[bl]
-        totals = st.leaf_sum[bl]
         right = totals - left
-        lg, lh, lc = left[0], left[1], left[2]
-        rg, rh, rc = right[0], right[1], right[2]
-        lval = leaf_output(lg, lh, hyper.lambda_l1, hyper.lambda_l2)
-        rval = leaf_output(rg, rh, hyper.lambda_l1, hyper.lambda_l2)
-
-        # smaller child (by physical rows) streamed; sibling by subtraction
-        nr = cnt - nl
-        ils = nl < nr
-        sm_start = jnp.where(ils, start, start + nl)
-        sm_cnt = jnp.where(ils, nl, nr)
-        sm_hist = hist_dyn(p, sm_start, sm_cnt, G, BH, bits=params.bits, interpret=interpret)
-        lg_hist = st.pool[bl] - sm_hist
-        left_hist = jnp.where(ils, sm_hist, lg_hist)
-        right_hist = jnp.where(ils, lg_hist, sm_hist)
-        pool = st.pool.at[bl].set(left_hist).at[right_leaf].set(right_hist)
-
-        child_depth = st.leaf_depth[bl] + 1
+        sums2 = jnp.stack([left, right])  # (2, 3)
+        vals2 = leaf_output(sums2[:, 0], sums2[:, 1], hyper.lambda_l1,
+                            hyper.lambda_l2)  # (2,)
         depth_ok = (
             jnp.array(True)
             if params.max_depth <= 0
             else child_depth < params.max_depth
         )
-        lres = find_best(left_hist, left, depth_ok)
-        rres = find_best(right_hist, right, depth_ok)
+        res2 = find2(hist2, sums2, depth_ok)
 
-        st = st._replace(
-            p=p,
-            scratch=scratch,
-            num_splits=s + 1,
-            starts=st.starts.at[right_leaf].set(start + nl),
-            cnts=st.cnts.at[bl].set(nl).at[right_leaf].set(nr),
-            pool=pool,
-            leaf_sum=st.leaf_sum.at[bl].set(left).at[right_leaf].set(right),
-            leaf_value=st.leaf_value.at[bl].set(lval).at[right_leaf].set(rval),
-            leaf_cnt=st.leaf_cnt.at[bl].set(lc).at[right_leaf].set(rc),
-            leaf_depth=st.leaf_depth.at[bl].set(child_depth).at[right_leaf].set(child_depth),
-            rec_leaf=st.rec_leaf.at[s].set(bl),
-            rec_feat=st.rec_feat.at[s].set(feat),
-            rec_thr=st.rec_thr.at[s].set(thr),
-            rec_dbz=st.rec_dbz.at[s].set(dbz),
-            rec_gain=st.rec_gain.at[s].set(gain),
-            rec_lval=st.rec_lval.at[s].set(lval),
-            rec_rval=st.rec_rval.at[s].set(rval),
-            rec_lcnt=st.rec_lcnt.at[s].set(lc),
-            rec_rcnt=st.rec_rcnt.at[s].set(rc),
-            rec_internal_value=st.rec_internal_value.at[s].set(st.leaf_value[bl]),
+        idx2 = jnp.stack([bl, rl])
+        seg2 = jnp.stack(
+            [jnp.stack([start, nl]), jnp.stack([start + nl, cnt - nl])]
         )
-        st = _store_split(st, bl, lres)
-        st = _store_split(st, right_leaf, rres)
-        return st
+        bs2 = jnp.stack(
+            [res2.gain, res2.feature.astype(jnp.float32),
+             res2.threshold_bin.astype(jnp.float32),
+             res2.default_bin_for_zero.astype(jnp.float32),
+             res2.left_sum_g, res2.left_sum_h, res2.left_cnt,
+             jnp.zeros((2,), jnp.float32)], axis=1
+        )  # (2, 8)
+        leaf2 = jnp.stack(
+            [sums2[:, 0], sums2[:, 1], sums2[:, 2], vals2, sums2[:, 2],
+             jnp.full((2,), child_depth),
+             jnp.zeros((2,)), jnp.zeros((2,))], axis=1
+        )  # (2, 8)
+        rec = jnp.stack(
+            [bl.astype(jnp.float32), feat.astype(jnp.float32),
+             thr.astype(jnp.float32), dbz.astype(jnp.float32), gain,
+             vals2[0], vals2[1], left[2], right[2], pval,
+             jnp.float32(0.0), jnp.float32(0.0)]
+        )
+
+        return st._replace(
+            p=p,
+            num_splits=s + 1,
+            seg=st.seg.at[idx2].set(seg2),
+            bs=st.bs.at[idx2].set(bs2),
+            leaf=st.leaf.at[idx2].set(leaf2),
+            recs=st.recs.at[s].set(rec),
+        )
 
     st = jax.lax.while_loop(cond, body, st)
+    recs = st.recs
     res = PTreeResult(
         num_splits=st.num_splits,
-        starts=st.starts,
-        cnts=st.cnts,
-        leaf_value=st.leaf_value,
-        leaf_cnt=st.leaf_cnt,
-        rec_leaf=st.rec_leaf,
-        rec_feat=st.rec_feat,
-        rec_thr=st.rec_thr,
-        rec_dbz=st.rec_dbz,
-        rec_gain=st.rec_gain,
-        rec_lval=st.rec_lval,
-        rec_rval=st.rec_rval,
-        rec_lcnt=st.rec_lcnt,
-        rec_rcnt=st.rec_rcnt,
-        rec_internal_value=st.rec_internal_value,
+        starts=st.seg[:, 0],
+        cnts=st.seg[:, 1],
+        leaf_value=st.leaf[:, 3],
+        leaf_cnt=st.leaf[:, 4],
+        recs_raw=recs,
+        rec_leaf=recs[:, 0].astype(jnp.int32),
+        rec_feat=recs[:, 1].astype(jnp.int32),
+        rec_thr=recs[:, 2].astype(jnp.int32),
+        rec_dbz=recs[:, 3].astype(jnp.int32),
+        rec_gain=recs[:, 4],
+        rec_lval=recs[:, 5],
+        rec_rval=recs[:, 6],
+        rec_lcnt=recs[:, 7],
+        rec_rcnt=recs[:, 8],
+        rec_internal_value=recs[:, 9],
     )
-    return res, st.p, st.scratch
+    return res, st.p
 
 
 def segment_values(tree: PTreeResult, num_rows: int, values: jnp.ndarray) -> jnp.ndarray:
     """(N,) vector assigning ``values[leaf]`` to each position of that
     leaf's segment — the partitioned-space replacement for
-    leaf_id-indexed lookups.  Built scatter-free for TPU: the segments
-    tile [0, N) contiguously, so the per-position value is a cumulative
-    sum of per-boundary deltas (one tiny (L,) scatter + one (N,) cumsum
-    instead of an (N,)-indexed gather)."""
+    leaf_id-indexed lookups.  Scatter- and sort-free range-add: +v at
+    each segment start, -v at each segment end, then one cumsum."""
     L = tree.starts.shape[0]
     active = jnp.arange(L) <= tree.num_splits
-    starts = jnp.where(active, tree.starts, num_rows)
-    order = jnp.argsort(starts)
-    sorted_starts = starts[order]
-    sorted_vals = jnp.where(active, values, 0.0)[order]
-    prev = jnp.concatenate([jnp.zeros((1,)), sorted_vals[:-1]])
-    deltas = sorted_vals - prev
-    line = jnp.zeros((num_rows,), jnp.float32).at[
-        jnp.clip(sorted_starts, 0, num_rows - 1)
-    ].add(jnp.where(sorted_starts < num_rows, deltas, 0.0))
-    return jnp.cumsum(line)
+    v = jnp.where(active, values, 0.0)
+    s = jnp.where(active, tree.starts, num_rows)
+    e = jnp.where(active, tree.starts + tree.cnts, num_rows)
+    line = jnp.zeros((num_rows + 1,), jnp.float32).at[s].add(v).at[e].add(-v)
+    return jnp.cumsum(line)[:num_rows]
 
 
 def leaf_id_from_segments(tree: PTreeResult, p: jnp.ndarray, layout: PLayout, num_rows: int) -> jnp.ndarray:

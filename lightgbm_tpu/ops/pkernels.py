@@ -7,34 +7,63 @@ src/treelearner/data_partition.hpp:94-150 ``Split``).
 
 The training matrix ``P`` is one (C, N) int32 array whose rows are
 
-    0..W-1 : packed bin words, 4 uint8 bins per int32 (W = ceil(F/4))
-    W + 0  : grad   (f32 bitcast)
-    W + 1  : hess   (f32 bitcast)
-    W + 2  : select (f32 bitcast; 0/1 bagging mask)
-    W + 3.. : driver-owned channels (scores, label, weight, row id) that
-             the kernels never touch but that travel with every row.
+    0..W-1      : packed bin words, 4 uint8 bins per int32 (W = ceil(F/4))
+    W..WPAD-1   : padding (WPAD = W rounded up to 8 sublanes)
+    WPAD + 0    : grad   (f32 bitcast)
+    WPAD + 1    : hess   (f32 bitcast)
+    WPAD + 2    : select (f32 bitcast; 0/1 bagging mask)
+    WPAD + 3..  : score channel(s), label, row id, weight — an 8-aligned
+                  "mutable band" so the in-place channel-update kernel can
+                  DMA it as one aligned row block.
 
 Rows are kept PHYSICALLY PARTITIONED by leaf: each leaf owns a
 contiguous column range [start, start+cnt).  That gives the reference's
-DataPartition asymptotics (O(N_leaf) per histogram / split, not O(N))
-without any gather — TPU gathers measure ~20 Mrow/s while streaming
-DMA + MXU runs at GB/s.
+DataPartition asymptotics (O(N_leaf) per split, not O(N)) without any
+gather — TPU gathers measure ~20 Mrow/s while streaming DMA + MXU runs
+at GB/s.
 
-All three kernels run as ONE grid step with an internal dynamic-length
-``fori_loop`` over BLK-column chunks, double-buffered HBM->VMEM DMA, and
-write in place via ``input_output_aliases`` (measured ~3 us/call inside
-a jitted while_loop).  DMA windows must be 128-lane aligned, so every
-stream runs on BLK-aligned windows with the segment's unaligned head
-phase absorbed by a carry buffer (preloaded with the existing head
-block) and the tail merged read-modify-write.
+Two hard-won backend facts shape this file (measured on v5e via the
+tunneled runtime):
+  1. ANY XLA-level write to the 64 MB packed matrix — even a one-element
+     `.at[0,0].add(1)` on a donated loop carry — triggers a pathological
+     whole-array copy costing 50-180 ms.  Only Pallas kernels with
+     ``input_output_aliases`` mutate it truly in place.  Hence
+     ``update_channels``: gradients / bagging / score updates stream the
+     mutable band through VMEM and write it back aliased.
+  2. The kernels are VPU-compute-bound, not HBM-bound: the (B, BLK)
+     bin-equality one-hots and the (BLK, BLK) permutation one-hots cost
+     ~1 us per 64 compares/lane-block, while the DMA itself is tens of
+     GB/s.  So histogram work is fused INTO the partition pass
+     (``split_stream``): the partition must stream the parent segment
+     anyway, and adding both children's histograms only widens the MXU
+     operand from 7 to 14 sublanes — free on a 128-wide systolic array.
+
+``split_stream`` replaces the old partition + copy-back + child-histogram
+trio with ONE pass: a two-ended in-place partition (blocks are consumed
+from both ends of the segment so vacated space always precedes the write
+frontiers — the protocol is simulated exhaustively in
+tests/test_pgrow.py) that accumulates (Σg, Σh, Σsel) per (feature, bin)
+for the left AND right children while each block is resident in VMEM.
+It needs NO scratch copy of the matrix (the old design kept a second
+full-size buffer: 670 MB at Higgs scale) and halves per-split traffic.
 
 Why matmuls everywhere: Mosaic has no vector scatter/gather and no
-cumsum, but the MXU is nearly free next to HBM bandwidth.  So
+cumsum, but the MXU is nearly free next to the VPU.  So
 - cumsum(goes_left) = one dot with a triangular ones matrix,
-- the in-block stable compaction is a one-hot permutation matmul applied
-  to the block's four byte planes (integers 0..255 are exact in bf16, so
-  the permutation is bit-exact on int32/f32 data),
+- the in-block compaction is a one-hot permutation matmul applied to the
+  block's four byte planes (integers 0..255 are exact in bf16, so the
+  permutation is bit-exact on int32/f32 data),
+- per-bin accumulation = dot of bf16 value rows with bin-equality
+  one-hots (3-term hi/mid/lo value split keeps f32 fidelity),
 exactly the trade SURVEY §7 prescribes (scatter -> one-hot matmul).
+
+Within-leaf row ORDER is not preserved (the two-ended scheme interleaves
+front and back blocks).  Nothing downstream depends on it: histograms,
+leaf sums and segment score updates are permutation-invariant, and the
+original row index travels in the ROWID channel for prediction/eval
+unscrambling.  (The reference's DataPartition::Split is stable, but no
+consumer of that stability exists there either — it falls out of its
+per-thread buffer merge.)
 """
 
 from __future__ import annotations
@@ -49,19 +78,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 BLK = 1024  # columns (data rows) per streamed chunk
 _LANE = 128  # DMA lane-alignment quantum
+_RING = 3  # read-buffer ring depth per stream end (max occupancy 2 + 1 inflight)
 
 
 def num_words(num_features: int, bits: int = 8) -> int:
     return -(-num_features // (32 // bits))
-
-
-def num_channels(num_features: int, num_score: int = 1, with_weight: bool = True,
-                 bits: int = 8) -> int:
-    """Total padded channel count: W words + g,h,sel + num_score scores +
-    label + rowid (+ weight), padded to a multiple of 8 (DMA sublane
-    tiling)."""
-    c = num_words(num_features, bits) + 3 + num_score + 2 + (1 if with_weight else 0)
-    return -(-c // 8) * 8
 
 
 class PLayout:
@@ -70,7 +91,12 @@ class PLayout:
     ``bits`` selects the bin word width: 8 (4 bins/int32) for max_bin up
     to 256, or 4 (8 bins/int32) when every column fits 16 bins — the TPU
     form of the reference's Dense4bitsBin (dense_nbits_bin.hpp:37),
-    halving resident bin bytes and per-row stream traffic."""
+    halving resident bin bytes and per-row stream traffic.
+
+    The mutable rows (grad/hess/select/scores + label/rowid/weight) live
+    in an 8-sublane-aligned band starting at WPAD so ``update_channels``
+    can DMA-slice them (Mosaic requires row-slice shapes and offsets
+    aligned to the (8, 128) tile)."""
 
     def __init__(self, num_features: int, num_score: int = 1, with_weight: bool = True,
                  bits: int = 8):
@@ -78,30 +104,62 @@ class PLayout:
         self.bits = bits
         self.per = 32 // bits
         self.W = num_words(num_features, bits)
-        self.G = self.W
-        self.H = self.W + 1
-        self.SEL = self.W + 2
-        self.SCORE = self.W + 3  # .. SCORE + num_score - 1
+        self.WPAD = -(-self.W // 8) * 8
+        # K grad/hess row PAIRS (multiclass trains K trees per iteration
+        # from K gradient planes computed once per iteration —
+        # GBDT::Boosting, gbdt.cpp:692-700); K == 1 reproduces the
+        # classic G/H/SEL/SCORE ordering exactly.
+        K = num_score
+        self.G = self.WPAD  # class-0 pair (g_row(0)/h_row(0))
+        self.H = self.WPAD + 1
+        self.SEL = self.WPAD + 2 * K
+        self.SCORE = self.SEL + 1  # .. SCORE + num_score - 1
         self.num_score = num_score
         self.LABEL = self.SCORE + num_score
         self.ROWID = self.LABEL + 1
         self.WEIGHT = self.ROWID + 1 if with_weight else -1
         self.with_weight = with_weight
-        self.C = num_channels(num_features, num_score, with_weight, bits)
+        band = 2 * K + 1 + num_score + 2 + (1 if with_weight else 0)
+        self.BAND = -(-band // 8) * 8
+        self.C = self.WPAD + self.BAND
+
+    def g_row(self, k: int) -> int:
+        return self.WPAD + 2 * k
+
+    def h_row(self, k: int) -> int:
+        return self.WPAD + 2 * k + 1
+
+    def class_rows(self, k: int):
+        """(g, h, sel) row triple for class k — static kernel param."""
+        return (self.g_row(k), self.h_row(k), self.SEL)
+
+    @property
+    def rows(self):
+        """(g, h, sel) row indices for class 0."""
+        return (self.G, self.H, self.SEL)
 
 
-def pack_matrix(bins: np.ndarray, layout: PLayout, label=None, weight=None) -> jnp.ndarray:
+def num_channels(num_features: int, num_score: int = 1, with_weight: bool = True,
+                 bits: int = 8) -> int:
+    return PLayout(num_features, num_score, with_weight, bits).C
+
+
+def pack_matrix(bins: np.ndarray, layout: PLayout, label=None, weight=None,
+                num_real=None) -> jnp.ndarray:
     """Build the (C, N + BLK) packed matrix from (N, F) uint8 bins.
 
     The BLK tail columns absorb block-granular DMA overruns.  grad/hess
     start at 0, select at 1, scores at 0; rowid is the original row
-    index (prediction / eval unscrambling)."""
+    index (prediction / eval unscrambling).  Rows >= ``num_real`` are
+    shard-padding dummies: select stays 0 so they never enter a
+    histogram (Metadata::CheckOrPartition's equal-shard padding)."""
     n, f = bins.shape
     assert f == layout.F
     assert bins.dtype == np.uint8, "partitioned path requires max_bin <= 256"
     assert int(bins.max(initial=0)) < (1 << layout.bits), (
         f"bin values exceed the {layout.bits}-bit word field"
     )
+    nr = n if num_real is None else int(num_real)
     w, per, bits = layout.W, layout.per, layout.bits
     pad_f = w * per - f
     bb = np.pad(np.asarray(bins), ((0, 0), (0, pad_f))).astype(np.uint32)
@@ -113,7 +171,7 @@ def pack_matrix(bins: np.ndarray, layout: PLayout, label=None, weight=None) -> j
     P = np.zeros((layout.C, n + BLK), np.int32)
     P[:w, :n] = words.T
     one = np.float32(1.0).view(np.int32)
-    P[layout.SEL, :n] = one
+    P[layout.SEL, :nr] = one
     if label is not None:
         P[layout.LABEL, :n] = np.asarray(label, np.float32).view(np.int32)
     P[layout.ROWID, :n] = np.arange(n, dtype=np.int32)
@@ -145,7 +203,9 @@ def pack_matrix_device(bins_dev, layout: PLayout, label=None, weight=None) -> jn
         return jax.lax.bitcast_convert_type(jnp.asarray(x, jnp.float32), jnp.int32)
 
     rows = [words.T]
-    rows.append(jnp.zeros((2, n), jnp.int32))  # g, h
+    if layout.WPAD > w:
+        rows.append(jnp.zeros((layout.WPAD - w, n), jnp.int32))
+    rows.append(jnp.zeros((2 * layout.num_score, n), jnp.int32))  # g/h pairs
     rows.append(jnp.full((1, n), one, jnp.int32))  # sel
     rows.append(jnp.zeros((layout.num_score, n), jnp.int32))  # scores
     rows.append(frow(label if label is not None else np.zeros(n, np.float32))[None, :])
@@ -156,24 +216,6 @@ def pack_matrix_device(bins_dev, layout: PLayout, label=None, weight=None) -> jn
     p = jnp.concatenate(rows, axis=0)
     cpad = layout.C - p.shape[0]
     return jnp.pad(p, ((0, cpad), (0, BLK)))
-
-
-def _tri_np() -> np.ndarray:
-    """(BLK, BLK) upper-triangular ones: dot(v, tri)[d] = cumsum_{s<=d} v[s]."""
-    i = np.arange(BLK)
-    return (i[:, None] <= i[None, :]).astype(np.float32)
-
-
-_TRI_NP = None
-
-
-def _get_tri():
-    """bf16 triangular constant; numpy-backed so traced calls never cache
-    a tracer."""
-    global _TRI_NP
-    if _TRI_NP is None:
-        _TRI_NP = _tri_np()
-    return jnp.asarray(_TRI_NP, jnp.bfloat16)
 
 
 def _planes(blk_i32, c):
@@ -193,12 +235,36 @@ def _unplanes(dots_f32, c):
     )
 
 
+def _split3(x):
+    """f32 -> 3 bf16 planes (hi, mid, lo): f32 fidelity at bf16 matmul
+    speed; the dot's sublane dim pads to 128 so extra rows are free."""
+    hi = x.astype(jnp.bfloat16)
+    r1 = x - hi.astype(jnp.float32)
+    mid = r1.astype(jnp.bfloat16)
+    lo = (r1 - mid.astype(jnp.float32)).astype(jnp.bfloat16)
+    return [hi, mid, lo]
+
+
+def _hist_from_rows(out, num_features, num_bins, row0=0):
+    """(Σ 3-term g, Σ 3-term h, cnt) rows -> (F, B, 3) histogram."""
+    hist = jnp.stack(
+        [
+            out[row0 + 0] + (out[row0 + 1] + out[row0 + 2]),
+            out[row0 + 3] + (out[row0 + 4] + out[row0 + 5]),
+            out[row0 + 6],
+        ],
+        axis=1,
+    )
+    return hist.reshape(num_features, num_bins, 3)
+
+
 # ======================================================================
-# histogram kernel
+# histogram kernel (root histogram / standalone segments)
 # ======================================================================
-def _hist_kernel(sref, p_any, o_ref, acc_ref, buf_ref, sem, *, nf, nb, w, c, fchunk, bits):
+def _hist_kernel(sref, p_any, o_ref, acc_ref, buf_ref, sem, *, nf, nb, rows, c, fchunk, bits):
     start = sref[0]
     cnt = sref[1]
+    g_row, h_row, sel_row = rows
     base = pl.multiple_of((start // BLK) * BLK, _LANE)
     head = start - base
     nblk = (head + cnt + BLK - 1) // BLK
@@ -225,22 +291,13 @@ def _hist_kernel(sref, p_any, o_ref, acc_ref, buf_ref, sem, *, nf, nb, w, c, fch
         blk = buf_ref[slot]
         pos = lane + j * BLK
         valid = ((pos >= head) & (pos < head + cnt)).astype(jnp.float32)
-        sel = pltpu.bitcast(blk[w + 2 : w + 3, :], jnp.float32) * valid
-        g = pltpu.bitcast(blk[w : w + 1, :], jnp.float32) * sel
-        h = pltpu.bitcast(blk[w + 1 : w + 2, :], jnp.float32) * sel
+        sel = pltpu.bitcast(blk[sel_row : sel_row + 1, :], jnp.float32) * valid
+        g = pltpu.bitcast(blk[g_row : g_row + 1, :], jnp.float32) * sel
+        h = pltpu.bitcast(blk[h_row : h_row + 1, :], jnp.float32) * sel
 
-        # f32 fidelity at bf16 speed: x = hi + mid + lo (3 bf16 terms);
-        # the dot's N dim pads to 128 lanes so extra value rows are free.
-        def split3(x):
-            hi = x.astype(jnp.bfloat16)
-            r1 = x - hi.astype(jnp.float32)
-            mid = r1.astype(jnp.bfloat16)
-            lo = (r1 - mid.astype(jnp.float32)).astype(jnp.bfloat16)
-            return hi, mid, lo
-
-        g3 = split3(g)
-        h3 = split3(h)
-        vals = jnp.concatenate(list(g3) + list(h3) + [sel.astype(jnp.bfloat16)], axis=0)
+        vals = jnp.concatenate(
+            _split3(g) + _split3(h) + [sel.astype(jnp.bfloat16)], axis=0
+        )
 
         per = 32 // bits
         mask = (1 << bits) - 1
@@ -265,18 +322,22 @@ def _hist_kernel(sref, p_any, o_ref, acc_ref, buf_ref, sem, *, nf, nb, w, c, fch
     o_ref[:, :] = acc_ref[:, :]
 
 
-@functools.partial(jax.jit, static_argnames=("num_features", "num_bins", "bits", "interpret"))
-def hist_dyn(p, start, cnt, num_features, num_bins, bits=8, interpret=False):
+@functools.partial(jax.jit, static_argnames=("num_features", "num_bins", "bits", "rows", "interpret"))
+def hist_dyn(p, start, cnt, num_features, num_bins, bits=8, rows=None, interpret=False):
     """(F, B, 3) histogram of the leaf segment [start, start+cnt) of the
     packed matrix ``p`` — DenseBin::ConstructHistogram (dense_bin.hpp:66)
     over the leaf's contiguous rows, streamed at HBM bandwidth.  bits=4
-    streams the Dense4bitsBin-packed form (8 bins per word)."""
-    w = num_words(num_features, bits)
+    streams the Dense4bitsBin-packed form (8 bins per word).  ``rows``
+    is the (g, h, sel) channel-row triple (PLayout.rows); defaults to the
+    standard layout for ``num_features``."""
+    if rows is None:
+        wpad = -(-num_words(num_features, bits) // 8) * 8
+        rows = (wpad, wpad + 1, wpad + 2)
     c = p.shape[0]
     fb = num_features * num_bins
     fchunk = max(1, min(num_features, 512 // num_bins))
     out = pl.pallas_call(
-        functools.partial(_hist_kernel, nf=num_features, nb=num_bins, w=w, c=c,
+        functools.partial(_hist_kernel, nf=num_features, nb=num_bins, rows=rows, c=c,
                           fchunk=fchunk, bits=bits),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -292,19 +353,447 @@ def hist_dyn(p, start, cnt, num_features, num_bins, bits=8, interpret=False):
         out_shape=jax.ShapeDtypeStruct((8, fb), jnp.float32),
         interpret=interpret,
     )(jnp.stack([jnp.int32(start), jnp.int32(cnt)]), p)
-    hist = jnp.stack(
-        [
-            out[0] + (out[1] + out[2]),
-            out[3] + (out[4] + out[5]),
-            out[6],
-        ],
-        axis=1,
-    )
-    return hist.reshape(num_features, num_bins, 3)
+    return _hist_from_rows(out, num_features, num_bins)
 
 
 # ======================================================================
-# partition kernel
+# update_and_root_hist: fused channel refresh + root histogram
+# ======================================================================
+def _upd_hist_kernel(sref, aux_any, p_any_in, p_any, o_ref, acc_ref, buf_ref, abuf,
+                     stage, rsem, asem, wsem, sem_unused, *, nf, nb, rows, c,
+                     fchunk, bits, grad_fn, lay_rows, use_sel, use_mul,
+                     use_weight, n_delta, n_score, k_grad):
+    """One streaming pass over ALL rows: score += delta, (g, h) =
+    grad_fn(score, label, weight), select = sel, block written back in
+    place, AND the root (F, B, 3) histogram accumulated from the fresh
+    values.  Structurally a copy of _hist_kernel (its DMA pattern
+    measures at full HBM bandwidth) plus a _stream_flush write-back.
+
+    ``lay_rows`` = (G, H, SEL, SCORE, LABEL, ROWID, WEIGHT) absolute row
+    indices."""
+    n = sref[0]
+    g_row, h_row, sel_row = rows
+    G_, H_, SEL_, SCORE_, LABEL_, ROWID_, WEIGHT_ = lay_rows
+    nblk = (n + BLK - 1) // BLK
+    acc_ref[:, :] = jnp.zeros_like(acc_ref)
+
+    def get_dma(slot, j):
+        return pltpu.make_async_copy(
+            p_any.at[:, pl.ds(j * BLK, BLK)], buf_ref.at[slot], rsem.at[slot]
+        )
+
+    def get_aux(slot, j):
+        return pltpu.make_async_copy(
+            aux_any.at[:, pl.ds(j * BLK, BLK)], abuf.at[slot], asem.at[slot]
+        )
+
+    get_dma(0, 0).start()
+    get_aux(0, 0).start()
+
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (nb, BLK), 0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, BLK), 1)
+
+    def body(j, _):
+        slot = jax.lax.rem(j, 2)
+
+        @pl.when(j + 1 < nblk)
+        def _():
+            get_dma(1 - slot, j + 1).start()
+            get_aux(1 - slot, j + 1).start()
+
+        get_dma(slot, j).wait()
+        get_aux(slot, j).wait()
+        blk = buf_ref[slot]
+        aux = abuf[slot]
+
+        # ---- channel update (single-class contract: multiclass runs
+        # update_multi_and_hists instead)
+        scores = pltpu.bitcast(blk[SCORE_ : SCORE_ + 1, :], jnp.float32)
+        if n_delta:
+            scores = scores + aux[0:1, :]
+        label = pltpu.bitcast(blk[LABEL_ : LABEL_ + 1, :], jnp.float32)
+        weight = (
+            pltpu.bitcast(blk[WEIGHT_ : WEIGHT_ + 1, :], jnp.float32)
+            if use_weight else None
+        )
+        gv, hv = grad_fn(scores, label, weight)
+        gv = gv.astype(jnp.float32)
+        hv = hv.astype(jnp.float32)
+        if use_mul:
+            # GOSS: sampled-rest rows carry the (n-top_k)/other_k
+            # gradient up-weighting (goss.hpp:112-117) — scales g/h but
+            # NOT the select row, so histogram counts stay row counts
+            mulv = aux[6:7, :]
+            gv = gv * mulv
+            hv = hv * mulv
+        if use_sel:
+            selv = aux[7:8, :]
+        else:
+            selv = pltpu.bitcast(blk[SEL_ : SEL_ + 1, :], jnp.float32)
+        out = blk
+        out = _setrow(out, G_, pltpu.bitcast(gv, jnp.int32))
+        out = _setrow(out, H_, pltpu.bitcast(hv, jnp.int32))
+        if use_sel:
+            out = _setrow(out, SEL_, pltpu.bitcast(selv, jnp.int32))
+        if n_delta:
+            out = _setrow(out, SCORE_, pltpu.bitcast(scores, jnp.int32))
+        _stream_flush(stage, wsem, p_any, out, j, j * BLK)
+
+        # ---- root histogram from the fresh values
+        pos = lane + j * BLK
+        valid = (pos < n).astype(jnp.float32)
+        sel = selv * valid
+        g = gv * sel
+        h = hv * sel
+        vals = jnp.concatenate(
+            _split3(g) + _split3(h) + [sel.astype(jnp.bfloat16)], axis=0
+        )
+        per = 32 // bits
+        mask = (1 << bits) - 1
+        for c0 in range(0, nf, fchunk):
+            c1 = min(c0 + fchunk, nf)
+            chunks = []
+            for f in range(c0, c1):
+                wd, p4 = divmod(f, per)
+                byte = (blk[wd : wd + 1, :] >> (p4 * bits)) & mask
+                chunks.append((byte == iota_b).astype(jnp.bfloat16))
+            oh = jnp.concatenate(chunks, axis=0)
+            acc_ref[0:7, c0 * nb : c1 * nb] += jax.lax.dot_general(
+                vals, oh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+        return 0
+
+    jax.lax.fori_loop(0, nblk, body, 0, unroll=False)
+    _stream_drain(stage, wsem, nblk)
+    o_ref[:, :] = acc_ref[:, :]
+
+
+def update_and_root_hist(p, layout: PLayout, grad_fn, delta=None, sel=None,
+                         mul=None, *, num_rows, num_features, num_bins,
+                         bits=8, rows=None, interpret: bool = False):
+    """Fused per-iteration channel maintenance + root histogram: ONE
+    streaming pass writes score += delta, fresh (g, h), bagging select —
+    in place via input_output_aliases — and returns the root (F, B, 3)
+    histogram of the fresh values (the fused trainer starts every tree
+    with exactly this pair).  GBDT::Boosting + Bagging + the root
+    ConstructHistogram in one pass (gbdt.cpp:692-700, 275-334)."""
+    if rows is None:
+        rows = layout.rows
+    ntot = p.shape[1]
+    c = p.shape[0]
+    fb = num_features * num_bins
+    fchunk = max(1, min(num_features, 512 // num_bins))
+
+    def fit(v):
+        v = jnp.asarray(v, jnp.float32)
+        pad = ntot - v.shape[0]
+        return jnp.concatenate([v, jnp.zeros((pad,), jnp.float32)]) if pad else v
+
+    zero = jnp.zeros((ntot,), jnp.float32)
+    use_sel = sel is not None
+    # aux rows 0..K-1: pending per-class score deltas; row 7: bagging
+    # select.  K <= 7 is enforced by the trainer's eligibility gate.
+    if delta is None:
+        n_delta = 0
+        drows = []
+    else:
+        delta = jnp.asarray(delta, jnp.float32)
+        if delta.ndim > 1:
+            delta = delta[0]
+        n_delta = 1
+        drows = [fit(delta)]
+    use_mul = mul is not None
+    rows8 = (drows + [zero] * (6 - len(drows))
+             + [fit(mul) if use_mul else zero]
+             + [fit(sel) if use_sel else zero])
+    aux = jnp.stack(rows8)
+    lay_rows = (layout.G, layout.H, layout.SEL, layout.SCORE, layout.LABEL,
+                layout.ROWID, layout.WEIGHT)
+    kern = functools.partial(
+        _upd_hist_kernel, nf=num_features, nb=num_bins, rows=rows, c=c,
+        fchunk=fchunk, bits=bits, grad_fn=grad_fn, lay_rows=lay_rows,
+        use_sel=use_sel, use_mul=use_mul, use_weight=layout.with_weight,
+        n_delta=n_delta, n_score=layout.num_score, k_grad=0,
+    )
+    p, out = pl.pallas_call(
+        kern,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(1,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),  # aux
+                pl.BlockSpec(memory_space=pl.ANY),  # P (alias)
+            ],
+            out_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((8, fb), jnp.float32),
+                pltpu.VMEM((2, c, BLK), jnp.int32),
+                pltpu.VMEM((2, 8, BLK), jnp.float32),
+                pltpu.VMEM((2, c, BLK), jnp.int32),  # write stage
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA(()),
+            ],
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(p.shape, jnp.int32),
+            jax.ShapeDtypeStruct((8, fb), jnp.float32),
+        ),
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )(jnp.stack([jnp.int32(num_rows)]), aux, p)
+    return p, _hist_from_rows(out, num_features, num_bins)
+
+
+# ======================================================================
+# update_multi_and_hists: K gradient planes + K root histograms, one pass
+# ======================================================================
+def _upd_multi_kernel(sref, aux_any, p_any_in, p_any, o_ref, acc_ref, buf_ref, abuf,
+                      stage, rsem, asem, wsem, *, nf, nb, c, fchunk, bits,
+                      grad_all_fn, lay, use_sel):
+    """One streaming pass over ALL rows: (g_k, h_k) for EVERY class k from
+    the score-channel snapshot (GBDT::Boosting computes all K gradient
+    planes once per iteration, gbdt.cpp:692-700), bagging select, the
+    block written back in place, and ALL K root histograms accumulated —
+    the K value groups just widen the MXU operand (7K+... sublanes)."""
+    n = sref[0]
+    K = lay.num_score
+    nblk = (n + BLK - 1) // BLK
+    acc_ref[:, :] = jnp.zeros_like(acc_ref)
+
+    def get_dma(slot, j):
+        return pltpu.make_async_copy(
+            p_any.at[:, pl.ds(j * BLK, BLK)], buf_ref.at[slot], rsem.at[slot]
+        )
+
+    def get_aux(slot, j):
+        return pltpu.make_async_copy(
+            aux_any.at[:, pl.ds(j * BLK, BLK)], abuf.at[slot], asem.at[slot]
+        )
+
+    get_dma(0, 0).start()
+    get_aux(0, 0).start()
+
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (nb, BLK), 0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, BLK), 1)
+
+    def body(j, _):
+        slot = jax.lax.rem(j, 2)
+
+        @pl.when(j + 1 < nblk)
+        def _():
+            get_dma(1 - slot, j + 1).start()
+            get_aux(1 - slot, j + 1).start()
+
+        get_dma(slot, j).wait()
+        get_aux(slot, j).wait()
+        blk = buf_ref[slot]
+        aux = abuf[slot]
+
+        scores = pltpu.bitcast(blk[lay.SCORE : lay.SCORE + K, :], jnp.float32)
+        label = pltpu.bitcast(blk[lay.LABEL : lay.LABEL + 1, :], jnp.float32)
+        weight = (
+            pltpu.bitcast(blk[lay.WEIGHT : lay.WEIGHT + 1, :], jnp.float32)
+            if lay.with_weight else None
+        )
+        gv, hv = grad_all_fn(scores, label, weight)  # (K, BLK) each
+        gv = gv.astype(jnp.float32)
+        hv = hv.astype(jnp.float32)
+        if use_sel:
+            selv = aux[7:8, :]
+        else:
+            selv = pltpu.bitcast(blk[lay.SEL : lay.SEL + 1, :], jnp.float32)
+        out = blk
+        for k in range(K):
+            out = _setrow(out, lay.g_row(k), pltpu.bitcast(gv[k : k + 1], jnp.int32))
+            out = _setrow(out, lay.h_row(k), pltpu.bitcast(hv[k : k + 1], jnp.int32))
+        if use_sel:
+            out = _setrow(out, lay.SEL, pltpu.bitcast(selv, jnp.int32))
+        _stream_flush(stage, wsem, p_any, out, j, j * BLK)
+
+        # ---- K root histograms from the fresh values
+        pos = lane + j * BLK
+        valid = (pos < n).astype(jnp.float32)
+        sel = selv * valid
+        groups = []
+        for k in range(K):
+            groups += _split3(gv[k : k + 1] * sel) + _split3(hv[k : k + 1] * sel)
+        groups.append(sel.astype(jnp.bfloat16))
+        vals = jnp.concatenate(groups, axis=0)  # (6K + 1, BLK)
+        per = 32 // bits
+        mask = (1 << bits) - 1
+        nv = 6 * K + 1
+        for c0 in range(0, nf, fchunk):
+            c1 = min(c0 + fchunk, nf)
+            chunks = []
+            for f in range(c0, c1):
+                wd, p4 = divmod(f, per)
+                byte = (blk[wd : wd + 1, :] >> (p4 * bits)) & mask
+                chunks.append((byte == iota_b).astype(jnp.bfloat16))
+            oh = jnp.concatenate(chunks, axis=0)
+            acc_ref[0:nv, c0 * nb : c1 * nb] += jax.lax.dot_general(
+                vals, oh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+        return 0
+
+    jax.lax.fori_loop(0, nblk, body, 0, unroll=False)
+    _stream_drain(stage, wsem, nblk)
+    o_ref[:, :] = acc_ref[:, :]
+
+
+def update_multi_and_hists(p, layout: PLayout, grad_all_fn, sel=None,
+                           *, num_rows, num_features, num_bins, bits=8,
+                           interpret: bool = False):
+    """Multiclass per-iteration channel maintenance: ALL K (g, h) planes
+    written from the same score snapshot + K root histograms, one
+    streaming pass.  Returns (p', [hist_k (F, B, 3) for k in range(K)])."""
+    K = layout.num_score
+    ntot = p.shape[1]
+    c = p.shape[0]
+    fb = num_features * num_bins
+    fchunk = max(1, min(num_features, 512 // num_bins))
+    nv = 6 * K + 1
+    nvpad = -(-nv // 8) * 8
+
+    def fit(v):
+        v = jnp.asarray(v, jnp.float32)
+        pad = ntot - v.shape[0]
+        return jnp.concatenate([v, jnp.zeros((pad,), jnp.float32)]) if pad else v
+
+    zero = jnp.zeros((ntot,), jnp.float32)
+    use_sel = sel is not None
+    aux = jnp.stack([zero] * 7 + [fit(sel) if use_sel else zero])
+    kern = functools.partial(
+        _upd_multi_kernel, nf=num_features, nb=num_bins, c=c, fchunk=fchunk,
+        bits=bits, grad_all_fn=grad_all_fn, lay=layout, use_sel=use_sel,
+    )
+    p, out = pl.pallas_call(
+        kern,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(1,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((nvpad, fb), jnp.float32),
+                pltpu.VMEM((2, c, BLK), jnp.int32),
+                pltpu.VMEM((2, 8, BLK), jnp.float32),
+                pltpu.VMEM((2, c, BLK), jnp.int32),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(p.shape, jnp.int32),
+            jax.ShapeDtypeStruct((nvpad, fb), jnp.float32),
+        ),
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )(jnp.stack([jnp.int32(num_rows)]), aux, p)
+    cnt = out[6 * K]
+    hists = []
+    for k in range(K):
+        g = out[6 * k + 0] + (out[6 * k + 1] + out[6 * k + 2])
+        h = out[6 * k + 3] + (out[6 * k + 4] + out[6 * k + 5])
+        hists.append(
+            jnp.stack([g, h, cnt], axis=1).reshape(num_features, num_bins, 3)
+        )
+    return p, hists
+
+
+# ======================================================================
+# score_add: in-place score-row segment update (multiclass per-tree)
+# ======================================================================
+def _score_add_kernel(sref, aux_any, p_any_in, p_any, buf_ref, abuf,
+                      stage, rsem, asem, wsem, *, c, score_row):
+    n = sref[0]
+    nblk = (n + BLK - 1) // BLK
+
+    def get_dma(slot, j):
+        return pltpu.make_async_copy(
+            p_any.at[:, pl.ds(j * BLK, BLK)], buf_ref.at[slot], rsem.at[slot]
+        )
+
+    def get_aux(slot, j):
+        return pltpu.make_async_copy(
+            aux_any.at[:, pl.ds(j * BLK, BLK)], abuf.at[slot], asem.at[slot]
+        )
+
+    get_dma(0, 0).start()
+    get_aux(0, 0).start()
+
+    def body(j, _):
+        slot = jax.lax.rem(j, 2)
+
+        @pl.when(j + 1 < nblk)
+        def _():
+            get_dma(1 - slot, j + 1).start()
+            get_aux(1 - slot, j + 1).start()
+
+        get_dma(slot, j).wait()
+        get_aux(slot, j).wait()
+        blk = buf_ref[slot]
+        sc = pltpu.bitcast(blk[score_row : score_row + 1, :], jnp.float32)
+        sc = sc + abuf[slot][0:1, :]
+        out = _setrow(blk, score_row, pltpu.bitcast(sc, jnp.int32))
+        _stream_flush(stage, wsem, p_any, out, j, j * BLK)
+        return 0
+
+    jax.lax.fori_loop(0, nblk, body, 0, unroll=False)
+    _stream_drain(stage, wsem, nblk)
+
+
+def score_add(p, layout: PLayout, delta, k: int = 0, *, num_rows,
+              interpret: bool = False):
+    """score channel k += delta (N,) in place — the per-tree score update
+    of the multiclass fused loop (applied IMMEDIATELY after each tree,
+    while the delta's row layout is still current)."""
+    ntot = p.shape[1]
+    c = p.shape[0]
+    v = jnp.asarray(delta, jnp.float32)
+    pad = ntot - v.shape[0]
+    if pad:
+        v = jnp.concatenate([v, jnp.zeros((pad,), jnp.float32)])
+    aux = jnp.concatenate([v[None, :], jnp.zeros((7, ntot), jnp.float32)], axis=0)
+    kern = functools.partial(_score_add_kernel, c=c, score_row=layout.SCORE + k)
+    return pl.pallas_call(
+        kern,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(1,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[
+                pltpu.VMEM((2, c, BLK), jnp.int32),
+                pltpu.VMEM((2, 8, BLK), jnp.float32),
+                pltpu.VMEM((2, c, BLK), jnp.int32),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct(p.shape, jnp.int32),
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )(jnp.stack([jnp.int32(num_rows)]), aux, p)
+
+
+# ======================================================================
+# split_stream: two-ended in-place partition + both-children histograms
 # ======================================================================
 def _stream_flush(stage, wsem, dst_any, merged, nstart, dst_off):
     """Start one aligned BLK write via the double-buffered stage.  Caller
@@ -331,10 +820,24 @@ def _stream_drain(stage, wsem, nstarts):
         pltpu.make_async_copy(stage.at[1], stage.at[1], wsem.at[1]).wait()
 
 
-def _part_kernel(
-    sref, tri_ref, p_in, s_in, p_any, s_any, nl_ref,
-    buf, carL, carR, stageL, stageR, tmp, rsem, csem, wsemL, wsemR, *, c, bits,
+def _split_kernel(
+    sref, p_in, p_any, hist_ref, nl_ref,
+    bufF, bufB, carL, carR, stageL, stageR, tri_ref,
+    rsemF, rsemB, csemL, csemR, wsemL, wsemR,
+    *, c, bits, nf, nb, rows, fchunk,
 ):
+    """One pass over the parent segment: stable-unordered in-place
+    partition by the split predicate + (F, B, 3) histograms of BOTH
+    children.
+
+    Two-ended block protocol (verified by exhaustive simulation in
+    tests/test_pgrow.py::test_twoend_protocol): blocks are read from the
+    front and the back of the segment; lefts compact forward into
+    front-vacated space, rights compact backward into back-vacated space.
+    Before classifying, any side whose vacated space hit zero is topped
+    up with a demand read; a flush whose target block is the other side's
+    in-flight read waits that read first.  Invariants guarantee writes
+    only ever land on blocks already read."""
     start = sref[0]
     cnt = sref[1]
     word = sref[2]
@@ -347,82 +850,223 @@ def _part_kernel(
     # feature's bins occupy stored values [off_lo, off_hi) with ``bias``
     # correcting a dropped zero default bin; values outside the range
     # mean "this feature at its default".  Unbundled features pass
-    # (0, 256, 0), making fb == raw value.
+    # (0, 1<<bits, 0), making fb == raw value.
     off_lo = sref[8]
     off_hi = sref[9]
     bias = sref[10]
+    g_row, h_row, sel_row = rows
+
     base = pl.multiple_of((start // BLK) * BLK, _LANE)
     head = start - base
-    nblk = (head + cnt + BLK - 1) // BLK
+    E = head + cnt
+    nblk = (E + BLK - 1) // BLK
 
-    def get_read(slot, j):
+    # triangular cumsum operand, built once per call (cheaper than an
+    # HBM-resident constant: reading a 2 MB tri per split costs more than
+    # one (BLK, BLK) compare)
+    ii = jax.lax.broadcasted_iota(jnp.int32, (BLK, BLK), 0)
+    jj = jax.lax.broadcasted_iota(jnp.int32, (BLK, BLK), 1)
+    tri_ref[:, :] = (ii <= jj).astype(jnp.bfloat16)
+
+    hist_ref[:, :] = jnp.zeros_like(hist_ref)
+
+    # preload carries: carL holds the head block (lanes < head preserved
+    # as pre-filled carry), carR the tail block (lanes >= E-(nblk-1)*BLK
+    # preserved, filled from the end)
+    cpL = pltpu.make_async_copy(p_any.at[:, pl.ds(base, BLK)], carL, csemL)
+    cpR = pltpu.make_async_copy(
+        p_any.at[:, pl.ds(base + (nblk - 1) * BLK, BLK)], carR, csemR
+    )
+    cpL.start()
+    cpR.start()
+    cpL.wait()
+    cpR.wait()
+
+    def dmaF(k):  # k-th front read = block k
+        slot = jax.lax.rem(k, _RING)
         return pltpu.make_async_copy(
-            p_any.at[:, pl.ds(base + j * BLK, BLK)], buf.at[slot], rsem.at[slot]
+            p_any.at[:, pl.ds(base + k * BLK, BLK)], bufF.at[slot], rsemF.at[slot]
         )
 
-    get_read(0, 0).start()
-    # preload the left carry with the existing head block: lanes < head are
-    # preserved verbatim through the first flush (the in-place RMW head).
-    pltpu.make_async_copy(p_any.at[:, pl.ds(base, BLK)], carL, csem).start()
-    pltpu.make_async_copy(p_any.at[:, pl.ds(base, BLK)], carL, csem).wait()
+    def dmaB(k):  # k-th back read = block nblk-1-k
+        slot = jax.lax.rem(k, _RING)
+        return pltpu.make_async_copy(
+            p_any.at[:, pl.ds(base + (nblk - 1 - k) * BLK, BLK)],
+            bufB.at[slot],
+            rsemB.at[slot],
+        )
 
     lane = jax.lax.broadcasted_iota(jnp.int32, (1, BLK), 1)
     iota_c = jax.lax.broadcasted_iota(jnp.int32, (c, 1), 0)
-    iota_d = jax.lax.broadcasted_iota(jnp.int32, (BLK, BLK), 0)
-    tri = tri_ref[:, :]
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (nb, BLK), 0)
+    per = 32 // bits
+    vmask = (1 << bits) - 1
 
     def body(j, st):
-        cl, fl, cr, fr = st
-        slot = jax.lax.rem(j, 2)
+        if_, ib, cf, cb, kf, kb, fl, fr, cl, cr = st
 
-        @pl.when(j + 1 < nblk)
+        # ---- demand reads: top up any side whose vacated space is 0
+        budget = if_ + ib < nblk
+        doF = ((cf - fl) == 0) & ((if_ > cf) | budget)
+        issF = doF & (if_ == cf)
+
+        @pl.when(issF)
         def _():
-            get_read(1 - slot, j + 1).start()
+            dmaF(if_).start()
 
-        get_read(slot, j).wait()
-        blk = buf[slot]
-        pos = lane + j * BLK
-        valid = (pos >= head) & (pos < head + cnt)
-        wordrow = jnp.sum(jnp.where(iota_c == word, blk, 0), axis=0, keepdims=True)
-        binv = (wordrow >> shift) & ((1 << bits) - 1)
+        if_ = if_ + issF
+
+        @pl.when(doF)
+        def _():
+            dmaF(cf).wait()
+
+        cf = cf + doF
+
+        budget = if_ + ib < nblk
+        doB = ((cb - fr) == 0) & ((ib > cb) | budget)
+        issB = doB & (ib == cb)
+
+        @pl.when(issB)
+        def _():
+            dmaB(ib).start()
+
+        ib = ib + issB
+
+        @pl.when(doB)
+        def _():
+            dmaB(cb).wait()
+
+        cb = cb + doB
+
+        # ---- force-consume so a hand block exists
+        budget = if_ + ib < nblk
+        noq = ((cf - kf) == 0) & ((cb - kb) == 0)
+        availF = (if_ > cf) | budget
+        doCF = noq & availF
+        issCF = doCF & (if_ == cf)
+
+        @pl.when(issCF)
+        def _():
+            dmaF(if_).start()
+
+        if_ = if_ + issCF
+
+        @pl.when(doCF)
+        def _():
+            dmaF(cf).wait()
+
+        cf = cf + doCF
+        doCB = noq & (~availF)
+        issCB = doCB & (ib == cb)
+
+        @pl.when(issCB)
+        def _():
+            dmaB(ib).start()
+
+        ib = ib + issCB
+
+        @pl.when(doCB)
+        def _():
+            dmaB(cb).wait()
+
+        cb = cb + doCB
+
+        # ---- hand block
+        useF = (cf - kf) > 0
+        slotF = jax.lax.rem(kf, _RING)
+        slotB = jax.lax.rem(kb, _RING)
+        hand = jnp.where(useF, bufF[slotF], bufB[slotB])
+        jh = jnp.where(useF, kf, nblk - 1 - kb)
+        kf = kf + useF
+        kb = kb + (~useF)
+
+        # ---- classify: split predicate (DataPartition::Split fused with
+        # the DefaultValueForZero bin remap of dense_bin.hpp:191-232)
+        pos = lane + jh * BLK
+        valid = (pos >= head) & (pos < E)
+        wordrow = jnp.sum(jnp.where(iota_c == word, hand, 0), axis=0, keepdims=True)
+        binv = (wordrow >> shift) & vmask
         in_range = (binv >= off_lo) & (binv < off_hi)
         fb = jnp.where(in_range, binv - off_lo + bias, zero_bin)
         fv = jnp.where(fb == zero_bin, dbz, fb)
         eqv = (fv == thr).astype(jnp.int32)
         lev = (fv <= thr).astype(jnp.int32)
+        # select on int32 (Mosaic cannot legalize arith.select on i1 vectors)
         gl = (jnp.where(is_cat == 1, eqv, lev) == 1) & valid
         gr = valid & (~gl)
+        glm = gl.astype(jnp.float32)
+        grm = gr.astype(jnp.float32)
 
-        glf = gl.astype(jnp.bfloat16)
-        grf = gr.astype(jnp.bfloat16)
-        cumL = jax.lax.dot_general(
-            glf, tri, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        # ---- both-children histograms while the block is in VMEM: the
+        # bin one-hots (the VPU-bound part) are shared; the value rows
+        # just widen 7 -> 14 sublanes (free on the MXU)
+        selv = pltpu.bitcast(hand[sel_row : sel_row + 1, :], jnp.float32)
+        gv = pltpu.bitcast(hand[g_row : g_row + 1, :], jnp.float32) * selv
+        hv = pltpu.bitcast(hand[h_row : h_row + 1, :], jnp.float32) * selv
+        vals = jnp.concatenate(
+            _split3(gv * glm) + _split3(hv * glm) + [(selv * glm).astype(jnp.bfloat16)]
+            + _split3(gv * grm) + _split3(hv * grm) + [(selv * grm).astype(jnp.bfloat16)],
+            axis=0,
+        )  # (14, BLK)
+        for c0 in range(0, nf, fchunk):
+            c1 = min(c0 + fchunk, nf)
+            chunks = []
+            for f in range(c0, c1):
+                wd, p4 = divmod(f, per)
+                byte = (hand[wd : wd + 1, :] >> (p4 * bits)) & vmask
+                chunks.append((byte == iota_b).astype(jnp.bfloat16))
+            oh = jnp.concatenate(chunks, axis=0)
+            hist_ref[0:14, c0 * nb : c1 * nb] += jax.lax.dot_general(
+                vals, oh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+
+        # ---- in-block compaction via permutation matmuls
+        lr = jnp.concatenate(
+            [glm.astype(jnp.bfloat16), grm.astype(jnp.bfloat16)], axis=0
+        )  # (2, BLK)
+        cum2 = jax.lax.dot_general(
+            lr, tri_ref[:, :], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.int32)
+        cumL = cum2[0:1]
+        cumR = cum2[1:2]
+        cntl = jnp.max(cumL)
+        cntr = jnp.max(cumR)
+        planes = _planes(hand, c)
+        tgtL = cl + cumL - 1
+        tgtL = tgtL - jnp.where(tgtL >= BLK, BLK, 0)
+        ohL = (gl & (ii == tgtL)).astype(jnp.bfloat16)
+        tgtR = BLK - cr - cumR
+        tgtR = tgtR + jnp.where(tgtR < 0, BLK, 0)
+        ohR = (gr & (ii == tgtR)).astype(jnp.bfloat16)
+        permL = _unplanes(
+            jax.lax.dot_general(planes, ohL, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32), c
         )
-        cumR = jax.lax.dot_general(
-            grf, tri, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        permR = _unplanes(
+            jax.lax.dot_general(planes, ohR, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32), c
         )
-        cumLi = cumL.astype(jnp.int32)
-        cumRi = cumR.astype(jnp.int32)
-        cntl = jnp.max(cumLi)
-        cntr = jnp.max(cumRi)
 
-        planes = _planes(blk, c)
-
-        def permute(sel_mask, cum_i, coff):
-            tgt = coff + cum_i - 1
-            tgt = tgt - jnp.where(tgt >= BLK, BLK, 0)
-            oh = (sel_mask & (iota_d == tgt)).astype(jnp.bfloat16)  # (D, S) d x s
-            dots = jax.lax.dot_general(
-                planes, oh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-            )  # (4C, D)
-            return _unplanes(dots, c)
-
-        permL = permute(gl, cumLi, cl)
-        permR = permute(gr, cumRi, cr)
-
+        # ---- left flush (forward, into front-vacated space)
         tL = cl + cntl
-        mergedL = jnp.where(lane < cl, carL[:, :], permL)
         flushL = tL >= BLK
+        # if the target block is an in-flight read, consume it first
+        nwB = flushL & (ib > cb) & (fl == nblk - 1 - cb)
+
+        @pl.when(nwB)
+        def _():
+            dmaB(cb).wait()
+
+        cb = cb + nwB
+        nwF = flushL & (if_ > cf) & (fl == cf)
+
+        @pl.when(nwF)
+        def _():
+            dmaF(cf).wait()
+
+        cf = cf + nwF
+        mergedL = jnp.where(lane < cl, carL[:, :], permL)
 
         @pl.when(flushL)
         def _():
@@ -430,184 +1074,104 @@ def _part_kernel(
 
         carL[:, :] = jnp.where(flushL, permL, mergedL)
         cl = jnp.where(flushL, tL - BLK, tL)
-        fl = fl + flushL.astype(jnp.int32)
+        fl = fl + flushL
 
+        # ---- right flush (backward, into back-vacated space)
         tR = cr + cntr
-        mergedR = jnp.where(lane < cr, carR[:, :], permR)
         flushR = tR >= BLK
+        rtgt = nblk - 1 - fr
+        nwB2 = flushR & (ib > cb) & (rtgt == nblk - 1 - cb)
+
+        @pl.when(nwB2)
+        def _():
+            dmaB(cb).wait()
+
+        cb = cb + nwB2
+        nwF2 = flushR & (if_ > cf) & (rtgt == cf)
+
+        @pl.when(nwF2)
+        def _():
+            dmaF(cf).wait()
+
+        cf = cf + nwF2
+        mergedR = jnp.where(lane >= BLK - cr, carR[:, :], permR)
 
         @pl.when(flushR)
         def _():
-            _stream_flush(stageR, wsemR, s_any, mergedR, fr, fr * BLK)
+            _stream_flush(stageR, wsemR, p_any, mergedR, fr, base + rtgt * BLK)
 
         carR[:, :] = jnp.where(flushR, permR, mergedR)
         cr = jnp.where(flushR, tR - BLK, tR)
-        fr = fr + flushR.astype(jnp.int32)
-        return (cl, fl, cr, fr)
+        fr = fr + flushR
 
-    cl, fl, cr, fr = jax.lax.fori_loop(
-        0, nblk, body, (head, jnp.int32(0), jnp.int32(0), jnp.int32(0)), unroll=False
+        # ---- prefetch the hand side
+        budget = if_ + ib < nblk
+        pfF = budget & useF & ((if_ - kf) < _RING)
+
+        @pl.when(pfF)
+        def _():
+            dmaF(if_).start()
+
+        if_ = if_ + pfF
+        budget = if_ + ib < nblk
+        pfB = budget & (~useF) & ((ib - kb) < _RING)
+
+        @pl.when(pfB)
+        def _():
+            dmaB(ib).start()
+
+        ib = ib + pfB
+        return (if_, ib, cf, cb, kf, kb, fl, fr, cl, cr)
+
+    z = jnp.int32(0)
+    st = jax.lax.fori_loop(
+        0, nblk, body,
+        (z, z, z, z, z, z, z, z, jnp.int32(head), nblk * BLK - E),
+        unroll=False,
     )
+    if_, ib, cf, cb, kf, kb, fl, fr, cl, cr = st
 
-    # final left flush: read-modify-write the tail block so columns past
-    # the carry fill keep their current bytes (to be overwritten by the
-    # rights copy-back, or beyond-segment data that must survive).
-    pltpu.make_async_copy(p_any.at[:, pl.ds(base + fl * BLK, BLK)], tmp, csem).start()
-    pltpu.make_async_copy(p_any.at[:, pl.ds(base + fl * BLK, BLK)], tmp, csem).wait()
-    mergedL = jnp.where(lane < cl, carL[:, :], tmp[:, :])
-    _stream_flush(stageL, wsemL, p_any, mergedL, fl, base + fl * BLK)
-    # final right flush: whole carry block (garbage tail masked at copy-back)
-    _stream_flush(stageR, wsemR, s_any, carR[:, :], fr, fr * BLK)
+    # the final carries exactly tile one block (cl + cr ∈ {0, BLK}):
+    # lefts at [0, cl), rights at [cl, BLK) == [BLK-cr, BLK)
+    has_mid = (cl + cr) > 0
 
-    _stream_drain(stageL, wsemL, fl + 1)
-    _stream_drain(stageR, wsemR, fr + 1)
+    @pl.when(has_mid)
+    def _():
+        merged = jnp.where(lane < cl, carL[:, :], carR[:, :])
+        _stream_flush(stageL, wsemL, p_any, merged, fl, base + fl * BLK)
+
+    _stream_drain(stageL, wsemL, fl + has_mid)
+    _stream_drain(stageR, wsemR, fr)
+
+    # drain any still-in-flight reads (their data is unused)
+    @pl.when(if_ > cf)
+    def _():
+        dmaF(cf).wait()
+
+    @pl.when(ib > cb)
+    def _():
+        dmaB(cb).wait()
+
     nl_ref[0] = fl * BLK + cl - head
 
 
-def _partition_call(p, scratch, tri, sv, bits=8, interpret=False):
+@functools.partial(jax.jit, static_argnames=("num_features", "num_bins", "bits", "rows", "interpret"))
+def split_stream(p, start, cnt, word, shift, zero_bin, dbz, thr, is_cat,
+                 off_lo=0, off_hi=256, bias=0, *, num_features, num_bins,
+                 bits=8, rows=None, interpret=False):
+    """Partition the leaf segment [start, start+cnt) of ``p`` in place by
+    the split predicate AND return both children's histograms from the
+    same pass.
+
+    Lefts land at [start, start+nl), rights at [start+nl, start+cnt)
+    (order within each child unspecified).  Returns
+    (p', nl, left_hist (F, B, 3), right_hist)."""
+    if rows is None:
+        wpad = -(-num_words(num_features, bits) // 8) * 8
+        rows = (wpad, wpad + 1, wpad + 2)
     c = p.shape[0]
-    nscr = scratch.shape[1]
-    return pl.pallas_call(
-        functools.partial(_part_kernel, c=c, bits=bits),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(1,),
-            in_specs=[
-                pl.BlockSpec(memory_space=pltpu.VMEM),  # tri
-                pl.BlockSpec(memory_space=pl.ANY),  # P (alias)
-                pl.BlockSpec(memory_space=pl.ANY),  # scratch (alias)
-            ],
-            out_specs=[
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-            ],
-            scratch_shapes=[
-                pltpu.VMEM((2, c, BLK), jnp.int32),  # read buf
-                pltpu.VMEM((c, BLK), jnp.int32),  # carL
-                pltpu.VMEM((c, BLK), jnp.int32),  # carR
-                pltpu.VMEM((2, c, BLK), jnp.int32),  # stageL
-                pltpu.VMEM((2, c, BLK), jnp.int32),  # stageR
-                pltpu.VMEM((c, BLK), jnp.int32),  # tmp (RMW)
-                pltpu.SemaphoreType.DMA((2,)),  # rsem
-                pltpu.SemaphoreType.DMA(()),  # csem
-                pltpu.SemaphoreType.DMA((2,)),  # wsemL
-                pltpu.SemaphoreType.DMA((2,)),  # wsemR
-            ],
-        ),
-        out_shape=(
-            jax.ShapeDtypeStruct(p.shape, jnp.int32),
-            jax.ShapeDtypeStruct(scratch.shape, jnp.int32),
-            jax.ShapeDtypeStruct((1,), jnp.int32),
-        ),
-        input_output_aliases={2: 0, 3: 1},
-        interpret=interpret,
-    )(sv, tri, p, scratch)
-
-
-# ======================================================================
-# copy-back kernel (rights: scratch[0:cntR) -> P[dst: dst+cntR))
-# ======================================================================
-def _copyback_kernel(sref, s_in, p_in, p_any, buf, car, stage, tmp, rsem, csem, wsem, *, c):
-    dst = sref[0]
-    cntr = sref[1]
-    base = pl.multiple_of((dst // BLK) * BLK, _LANE)
-    head = dst - base
-    nblk = (cntr + BLK - 1) // BLK
-    s_any = s_in
-
-    def get_read(slot, j):
-        return pltpu.make_async_copy(
-            s_any.at[:, pl.ds(j * BLK, BLK)], buf.at[slot], rsem.at[slot]
-        )
-
-    get_read(0, 0).start()
-    pltpu.make_async_copy(p_any.at[:, pl.ds(base, BLK)], car, csem).start()
-    pltpu.make_async_copy(p_any.at[:, pl.ds(base, BLK)], car, csem).wait()
-
-    lane = jax.lax.broadcasted_iota(jnp.int32, (1, BLK), 1)
-    iota_d = jax.lax.broadcasted_iota(jnp.int32, (BLK, BLK), 0)
-    # constant cyclic shift by `head`: src already compact, so rank = lane
-    tgt = head + lane
-    tgt = tgt - jnp.where(tgt >= BLK, BLK, 0)
-    oh_shift = (iota_d == tgt).astype(jnp.bfloat16)
-
-    def body(j, st):
-        cl, fl = st
-        slot = jax.lax.rem(j, 2)
-
-        @pl.when(j + 1 < nblk)
-        def _():
-            get_read(1 - slot, j + 1).start()
-
-        get_read(slot, j).wait()
-        blk = buf[slot]
-        n_in = jnp.minimum(cntr - j * BLK, BLK)
-        planes = _planes(blk, c)
-        valid = lane < n_in
-        oh = jnp.where(valid, oh_shift, jnp.bfloat16(0.0))
-        dots = jax.lax.dot_general(
-            planes, oh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        perm = _unplanes(dots, c)
-        t = cl + n_in
-        merged = jnp.where(lane < cl, car[:, :], perm)
-        flush = t >= BLK
-
-        @pl.when(flush)
-        def _():
-            _stream_flush(stage, wsem, p_any, merged, fl, base + fl * BLK)
-
-        car[:, :] = jnp.where(flush, perm, merged)
-        cl = jnp.where(flush, t - BLK, t)
-        fl = fl + flush.astype(jnp.int32)
-        return (cl, fl)
-
-    cl, fl = jax.lax.fori_loop(0, nblk, body, (head, jnp.int32(0)), unroll=False)
-    pltpu.make_async_copy(p_any.at[:, pl.ds(base + fl * BLK, BLK)], tmp, csem).start()
-    pltpu.make_async_copy(p_any.at[:, pl.ds(base + fl * BLK, BLK)], tmp, csem).wait()
-    merged = jnp.where(lane < cl, car[:, :], tmp[:, :])
-    _stream_flush(stage, wsem, p_any, merged, fl, base + fl * BLK)
-    _stream_drain(stage, wsem, fl + 1)
-
-
-def _copyback_call(p, scratch, sv, interpret=False):
-    c = p.shape[0]
-    return pl.pallas_call(
-        functools.partial(_copyback_kernel, c=c),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(1,),
-            in_specs=[
-                pl.BlockSpec(memory_space=pl.ANY),  # scratch (read)
-                pl.BlockSpec(memory_space=pl.ANY),  # P (alias)
-            ],
-            out_specs=pl.BlockSpec(memory_space=pl.ANY),
-            scratch_shapes=[
-                pltpu.VMEM((2, c, BLK), jnp.int32),
-                pltpu.VMEM((c, BLK), jnp.int32),  # carry
-                pltpu.VMEM((2, c, BLK), jnp.int32),  # stage
-                pltpu.VMEM((c, BLK), jnp.int32),  # tmp
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA(()),
-                pltpu.SemaphoreType.DMA((2,)),
-            ],
-        ),
-        out_shape=jax.ShapeDtypeStruct(p.shape, jnp.int32),
-        input_output_aliases={2: 0},
-        interpret=interpret,
-    )(sv, scratch, p)
-
-
-@functools.partial(jax.jit, static_argnames=("bits", "interpret"))
-def partition_segment(p, scratch, start, cnt, word, shift, zero_bin, dbz, thr, is_cat,
-                      off_lo=0, off_hi=256, bias=0, bits=8, interpret=False):
-    """Stable-partition the leaf segment [start, start+cnt) of ``p`` by
-    the split predicate (DataPartition::Split, data_partition.hpp:94-150,
-    fused with the DefaultValueForZero bin remap of dense_bin.hpp:191-232).
-
-    Lefts land at [start, start+nl), rights at [start+nl, start+cnt),
-    in place.  Returns (p', scratch', nl)."""
+    fb = num_features * num_bins
+    fchunk = max(1, min(num_features, 512 // num_bins))
     sv = jnp.stack(
         [
             jnp.int32(start), jnp.int32(cnt), jnp.int32(word), jnp.int32(shift),
@@ -615,17 +1179,217 @@ def partition_segment(p, scratch, start, cnt, word, shift, zero_bin, dbz, thr, i
             jnp.int32(off_lo), jnp.int32(off_hi), jnp.int32(bias),
         ]
     )
-    tri = _get_tri()
-    p, scratch, nl = _partition_call(p, scratch, tri, sv, bits=bits, interpret=interpret)
-    nl = nl[0]
-    cntr = cnt - nl
-    sv2 = jnp.stack([jnp.int32(start) + nl, cntr])
-    p = _copyback_call(p, scratch, sv2, interpret=interpret)
-    return p, scratch, nl
+    p, hist, nl = pl.pallas_call(
+        functools.partial(_split_kernel, c=c, bits=bits, nf=num_features,
+                          nb=num_bins, rows=rows, fchunk=fchunk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(1,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],  # P (alias)
+            out_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((_RING, c, BLK), jnp.int32),  # bufF
+                pltpu.VMEM((_RING, c, BLK), jnp.int32),  # bufB
+                pltpu.VMEM((c, BLK), jnp.int32),  # carL
+                pltpu.VMEM((c, BLK), jnp.int32),  # carR
+                pltpu.VMEM((2, c, BLK), jnp.int32),  # stageL
+                pltpu.VMEM((2, c, BLK), jnp.int32),  # stageR
+                pltpu.VMEM((BLK, BLK), jnp.bfloat16),  # tri
+                pltpu.SemaphoreType.DMA((_RING,)),  # rsemF
+                pltpu.SemaphoreType.DMA((_RING,)),  # rsemB
+                pltpu.SemaphoreType.DMA(()),  # csemL
+                pltpu.SemaphoreType.DMA(()),  # csemR
+                pltpu.SemaphoreType.DMA((2,)),  # wsemL
+                pltpu.SemaphoreType.DMA((2,)),  # wsemR
+            ],
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(p.shape, jnp.int32),
+            jax.ShapeDtypeStruct((16, fb), jnp.float32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+        ),
+        input_output_aliases={1: 0},
+        interpret=interpret,
+    )(sv, p)
+    left = _hist_from_rows(hist, num_features, num_bins, row0=0)
+    right = _hist_from_rows(hist, num_features, num_bins, row0=7)
+    return p, nl[0], left, right
 
 
 # ======================================================================
-# pure-XLA reference implementations (CPU tests / documentation)
+# update_channels: in-place gradient / bagging / score channel refresh
+# ======================================================================
+_URING = 8  # ring depth for the band streamer
+_UAHEAD = 5  # reads primed ahead; write waits then trail by R-K=3 blocks
+#             (an inline start-then-wait write measures ~100 us/block on
+#             the tunneled runtime; >=2-deep deferral hides it entirely)
+
+
+def _update_kernel(aux_any, p_in, p_any, buf, abuf, rsem, asem, wsem, *,
+                   band0, bandn, naux, nblk, grad_fn, score_off, label_off,
+                   weight_off, use_weight, use_sel, k_class):
+    """Stream the mutable band: score += delta (aux row 0), then
+    (g, h) = grad_fn(score, label, weight) written into rows 0..1 of the
+    band, select = aux row 1 (bagging) when use_sel.
+
+    The band layout within the streamed window is
+      [0]=g [1]=h [2]=sel [3..3+K-1]=scores [3+K]=label [4+K]=rowid
+      [5+K]=weight — i.e. rows [band0, band0+bandn) of P.
+
+    One ring of _URING block buffers: block j reads into and writes back
+    from slot j%R.  Reads run _UAHEAD blocks ahead; starting read j+K
+    first waits write j+K-R (same slot), giving every write R-K blocks
+    of slack before anything blocks on it."""
+    R, K = _URING, _UAHEAD
+
+    def rd(j):
+        sl = jax.lax.rem(j, R)
+        return pltpu.make_async_copy(
+            p_any.at[band0 : band0 + bandn, pl.ds(j * BLK, BLK)], buf.at[sl], rsem.at[sl]
+        )
+
+    def rda(j):
+        sl = jax.lax.rem(j, R)
+        return pltpu.make_async_copy(
+            aux_any.at[:, pl.ds(j * BLK, BLK)], abuf.at[sl], asem.at[sl]
+        )
+
+    def wr(j):
+        sl = jax.lax.rem(j, R)
+        return pltpu.make_async_copy(
+            buf.at[sl], p_any.at[band0 : band0 + bandn, pl.ds(j * BLK, BLK)], wsem.at[sl]
+        )
+
+    for k in range(min(K, nblk)):
+        rd(k).start()
+        rda(k).start()
+
+    def body(j, _):
+        sl = jax.lax.rem(j, R)
+        rd(j).wait()
+        rda(j).wait()
+        blk = buf[sl]
+        aux = abuf[sl]
+        delta = aux[0:1, :]
+        score = pltpu.bitcast(blk[score_off + k_class : score_off + k_class + 1, :],
+                              jnp.float32) + delta
+        label = pltpu.bitcast(blk[label_off : label_off + 1, :], jnp.float32)
+        if use_weight:
+            weight = pltpu.bitcast(blk[weight_off : weight_off + 1, :], jnp.float32)
+        else:
+            weight = None
+        g, h = grad_fn(score, label, weight)
+        out = blk
+        out = _setrow(out, 0, pltpu.bitcast(g.astype(jnp.float32), jnp.int32))
+        out = _setrow(out, 1, pltpu.bitcast(h.astype(jnp.float32), jnp.int32))
+        if use_sel:
+            out = _setrow(out, 2, pltpu.bitcast(aux[1:2, :], jnp.int32))
+        out = _setrow(out, score_off + k_class,
+                      pltpu.bitcast(score, jnp.int32))
+        buf[sl] = out
+        wr(j).start()
+
+        @pl.when(j + K < nblk)
+        def _():
+            @pl.when(j + K - R >= 0)
+            def _():
+                wr(j + K - R).wait()
+
+            rd(j + K).start()
+            rda(j + K).start()
+
+        return 0
+
+    jax.lax.fori_loop(0, nblk, body, 0, unroll=False)
+    # drain: the in-loop wait fires only while reads remain (j+K < nblk),
+    # so the last min(R, nblk) writes are still un-waited
+    for k in range(min(R, nblk)):
+        wr(nblk - 1 - k).wait()
+
+
+def _setrow(mat, r, row):
+    """Replace row ``r`` (static) of (R, BLK) with (1, BLK) ``row``.
+    Builds without zero-size slices (Mosaic rejects (0, BLK) vectors)."""
+    parts = []
+    if r > 0:
+        parts.append(mat[:r])
+    parts.append(row)
+    if r + 1 < mat.shape[0]:
+        parts.append(mat[r + 1 :])
+    return jnp.concatenate(parts, axis=0) if len(parts) > 1 else row
+
+
+def update_channels(p, layout: PLayout, grad_fn, delta=None, sel=None,
+                    k_class: int = 0, interpret: bool = False):
+    """In-place refresh of the mutable band: ``score[k] += delta`` then
+    ``g, h = grad_fn(score, label, weight)`` and optionally
+    ``select = sel`` — the per-iteration channel maintenance of the fused
+    trainer (GBDT::Boosting + Bagging, gbdt.cpp:692-700, 275-334) as ONE
+    aliased Pallas pass.
+
+    Exists because ANY XLA-level write to the big matrix (even a
+    one-element update on a donated loop carry) costs a pathological
+    whole-array copy on this backend; only Pallas input_output_aliases
+    mutate in place.  ``delta``/``sel`` are (N,)-or-longer f32 vectors
+    (padded with zeros up to p.shape[1] here)."""
+    ntot = p.shape[1]
+    # floor, not ceil: P has n + BLK columns, so floor(ntot/BLK) blocks
+    # always cover every real row without the last window overrunning
+    nblk = ntot // BLK
+    aux_rows = []
+    zero = jnp.zeros((ntot,), jnp.float32)
+
+    def fit(v):
+        v = jnp.asarray(v, jnp.float32)
+        pad = ntot - v.shape[0]
+        return jnp.concatenate([v, jnp.zeros((pad,), jnp.float32)]) if pad else v
+
+    aux_rows.append(fit(delta) if delta is not None else zero)
+    use_sel = sel is not None
+    aux_rows.append(fit(sel) if use_sel else zero)
+    # 8 rows: DMA row-slices must be (8, 128)-tile aligned; rows 2..7 pad
+    aux = jnp.concatenate(
+        [jnp.stack(aux_rows), jnp.zeros((6, ntot), jnp.float32)], axis=0
+    )  # (8, ntot) f32
+
+    band0, bandn = layout.WPAD, layout.BAND
+    kern = functools.partial(
+        _update_kernel,
+        band0=band0, bandn=bandn, naux=2, nblk=nblk, grad_fn=grad_fn,
+        score_off=3 + 0, label_off=3 + layout.num_score,
+        weight_off=3 + layout.num_score + 2,
+        use_weight=layout.with_weight, use_sel=use_sel, k_class=k_class,
+    )
+    return pl.pallas_call(
+        kern,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=(1,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),  # aux
+                pl.BlockSpec(memory_space=pl.ANY),  # P (alias)
+            ],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[
+                pltpu.VMEM((_URING, bandn, BLK), jnp.int32),
+                pltpu.VMEM((_URING, 8, BLK), jnp.float32),
+                pltpu.SemaphoreType.DMA((_URING,)),
+                pltpu.SemaphoreType.DMA((_URING,)),
+                pltpu.SemaphoreType.DMA((_URING,)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct(p.shape, jnp.int32),
+        input_output_aliases={1: 0},
+        interpret=interpret,
+    )(aux, p)
+
+
+# ======================================================================
+# pure-XLA / numpy reference implementations (CPU tests / documentation)
 # ======================================================================
 def unpack_bins(p, layout: PLayout, n: int) -> jnp.ndarray:
     """(N, F) uint8 bins recovered from the packed words (test helper)."""
@@ -652,8 +1416,9 @@ def hist_ref(p, start: int, cnt: int, layout: PLayout, num_bins: int) -> jnp.nda
 
 
 def partition_ref(p, start: int, cnt: int, feat: int, zero_bin: int, dbz: int, thr: int, is_cat: bool, layout: PLayout):
-    """Reference (numpy) stable partition — same contract as
-    partition_segment."""
+    """Reference (numpy) stable partition — the expected ROW SETS of
+    split_stream (which is unordered within each side: compare sorted by
+    the ROWID channel)."""
     pn = np.asarray(p)
     seg = pn[:, start : start + cnt]
     wd, p4 = divmod(feat, layout.per)
