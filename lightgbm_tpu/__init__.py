@@ -62,6 +62,7 @@ def enable_compile_cache():
 from .basic import Booster, Dataset
 from .engine import cv, train
 from .callback import early_stopping, log_evaluation, record_evaluation, reset_parameter
+from .utils.log import LightGBMError
 
 try:  # sklearn wrappers are optional (sklearn is present in CI images)
     from .sklearn import LGBMModel, LGBMRegressor, LGBMClassifier, LGBMRanker
@@ -79,6 +80,7 @@ from . import config, metric, objective
 __all__ = [
     "Dataset",
     "Booster",
+    "LightGBMError",
     "train",
     "cv",
     "LGBMModel",

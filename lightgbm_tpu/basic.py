@@ -21,12 +21,31 @@ from .objective import create_objective
 from .utils.log import Log
 
 
-def _to_2d_float(data):
+def _to_2d_float(data, want_cats: bool = False):
+    """-> (array, column_names) or, with ``want_cats``, (array, names,
+    auto_categorical_indices).  Pandas ``category`` dtype columns are
+    mapped to their integer codes (missing -> NaN) and reported as
+    auto-detected categorical features, mirroring the reference's pandas
+    handling under categorical_feature="auto"
+    (python-package/lightgbm/basic.py _data_from_pandas)."""
     try:
         import pandas as pd
 
         if isinstance(data, pd.DataFrame):
-            return data.to_numpy(dtype=np.float64), [str(c) for c in data.columns]
+            cat_idx = [i for i, c in enumerate(data.columns)
+                       if isinstance(data.dtypes.iloc[i], pd.CategoricalDtype)]
+            levels = []
+            if cat_idx:
+                data = data.copy(deep=False)
+                for i in cat_idx:
+                    col = data.columns[i]
+                    levels.append(list(data[col].cat.categories))
+                    codes = data[col].cat.codes.to_numpy(np.float64)
+                    codes[codes < 0] = np.nan  # code -1 == missing
+                    data[col] = codes
+            arr = data.to_numpy(dtype=np.float64)
+            names = [str(c) for c in data.columns]
+            return (arr, names, cat_idx, levels) if want_cats else (arr, names)
     except ImportError:
         pass
     # scipy CSR/CSC input (basic.py __init_from_csr/__init_from_csc):
@@ -38,11 +57,34 @@ def _to_2d_float(data):
             "(%d x %d); EFB bundling recovers the memory on device",
             *data.shape,
         )
-        return np.asarray(data.toarray(), dtype=np.float64), None
+        arr = np.asarray(data.toarray(), dtype=np.float64)
+        return (arr, None, [], []) if want_cats else (arr, None)
     arr = np.asarray(data, dtype=np.float64)
     if arr.ndim == 1:
         arr = arr.reshape(-1, 1)
-    return arr, None
+    return (arr, None, [], []) if want_cats else (arr, None)
+
+
+def _map_pandas_categorical(data, pandas_categorical):
+    """Predict-time DataFrame: map category columns through the TRAINING
+    category order (reference basic.py _data_from_pandas +
+    pandas_categorical round-trip) so codes line up with the model."""
+    try:
+        import pandas as pd
+    except ImportError:  # pragma: no cover
+        return data
+    if not isinstance(data, pd.DataFrame) or not pandas_categorical:
+        return data
+    cat_cols = [c for i, c in enumerate(data.columns)
+                if isinstance(data.dtypes.iloc[i], pd.CategoricalDtype)]
+    if not cat_cols:
+        return data
+    data = data.copy(deep=False)
+    for col, levels in zip(cat_cols, pandas_categorical):
+        codes = pd.Categorical(data[col], categories=levels).codes.astype(np.float64)
+        codes[codes < 0] = np.nan
+        data[col] = codes
+    return data
 
 
 class Dataset:
@@ -67,9 +109,12 @@ class Dataset:
             self.data_path = data
             self.data = None
             self.pandas_columns = None
+            self._auto_categorical = []
+            self.pandas_categorical = []
         else:
             self.data_path = None
-            self.data, self.pandas_columns = _to_2d_float(data)
+            (self.data, self.pandas_columns, self._auto_categorical,
+             self.pandas_categorical) = _to_2d_float(data, want_cats=True)
         self.label = label
         self.max_bin = max_bin
         self.reference = reference
@@ -150,6 +195,12 @@ class Dataset:
                         Log.fatal("Unknown categorical feature %s", c)
                 else:
                     cats.append(int(c))
+        elif self.categorical_feature == "auto" and getattr(
+            self, "_auto_categorical", None
+        ):
+            # pandas category dtype columns (mapped to codes in
+            # _to_2d_float) become categorical features automatically
+            cats = list(self._auto_categorical)
 
         ref = self.reference.construct() if self.reference is not None else None
         self._constructed = BinnedDataset.from_raw(
@@ -244,6 +295,8 @@ class Dataset:
         sub.data_path = None
         sub.data = self.data[used_indices] if self.data is not None else None
         sub.pandas_columns = self.pandas_columns
+        sub._auto_categorical = list(getattr(self, "_auto_categorical", []))
+        sub.pandas_categorical = list(getattr(self, "pandas_categorical", []))
         sub.label = None
         sub.max_bin = self.max_bin
         sub.reference = self
@@ -276,8 +329,10 @@ class Booster:
         self.best_score: Dict[str, Dict[str, float]] = {}
         self._name_to_index: Dict[str, int] = {}
 
+        self.pandas_categorical = []
         if train_set is not None:
             self.config = Config.from_params(self.params)
+            self.pandas_categorical = getattr(train_set, "pandas_categorical", [])
             # dataset-relevant train params reach construction unless the
             # Dataset set them explicitly (Dataset._update_params: the
             # dataset's own params win, booster params fill the gaps) —
@@ -298,6 +353,7 @@ class Booster:
             if model_file is not None:
                 with open(model_file) as f:
                     model_str = f.read()
+            model_str = self._strip_pandas_categorical(model_str)
             self.config = Config.from_params(self.params)
             self.boosting = create_boosting("gbdt")
             self.boosting.config = self.config
@@ -312,6 +368,22 @@ class Booster:
             Log.fatal("Booster needs a train_set, model_file or model_str")
 
     # ------------------------------------------------------------------
+    def _strip_pandas_categorical(self, model_str: str) -> str:
+        """Parse + remove the trailing pandas_categorical json line
+        (written by model_to_string; reference model-file convention)."""
+        marker = "\npandas_categorical:"
+        pos = model_str.rfind(marker)
+        if pos >= 0:
+            import json
+
+            line = model_str[pos + len(marker):].splitlines()[0].strip()
+            try:
+                self.pandas_categorical = json.loads(line) or []
+            except ValueError:
+                self.pandas_categorical = []
+            model_str = model_str[:pos] + model_str[pos + len(marker) + len(line) + 1:]
+        return model_str
+
     def _objective_from_model_string(self, obj_str: str):
         if not obj_str:
             return None
@@ -435,6 +507,7 @@ class Booster:
             feats, _, _, _, _, _ = load_text_file(data, self.config)
             data = feats
         else:
+            data = _map_pandas_categorical(data, self.pandas_categorical)
             data, _ = _to_2d_float(data)
         return self.boosting.predict(
             data, num_iteration=num_iteration, raw_score=raw_score, pred_leaf=pred_leaf
@@ -442,11 +515,19 @@ class Booster:
 
     # ------------------------------------------------------------------
     def save_model(self, filename: str, num_iteration: int = -1) -> "Booster":
-        self.boosting.save_model_to_file(filename, num_iteration)
+        with open(filename, "w") as f:
+            f.write(self.model_to_string(num_iteration))
         return self
 
     def model_to_string(self, num_iteration: int = -1) -> str:
-        return self.boosting.save_model_to_string(num_iteration)
+        s = self.boosting.save_model_to_string(num_iteration)
+        if self.pandas_categorical:
+            import json
+
+            s += "\npandas_categorical:" + json.dumps(
+                self.pandas_categorical, default=str
+            ) + "\n"
+        return s
 
     def dump_model(self, num_iteration: int = -1) -> dict:
         """JSON dump (GBDT::DumpModel, gbdt.cpp:702-736)."""

@@ -186,7 +186,7 @@ class PartitionedTrainer:
             lay = self.layout
             interp = self.interpret
 
-            @jax.jit
+            @functools.partial(jax.jit, donate_argnums=(0,))
             def prog(p, delta):
                 return score_add(p, lay, delta, k, num_rows=self.num_rows,
                                  interpret=interp)
@@ -259,15 +259,29 @@ class PartitionedTrainer:
         @functools.partial(jax.jit, donate_argnums=(0,))
         def prog(p, lr, key, iter0, t_run):
             def one_iter(t, carry):
-                (p, recs, stopped, delta) = carry
+                # once an iteration produced an empty tree, training has
+                # logically stopped: later in-program iterations must be
+                # FULL no-ops — growing a throwaway tree would repartition
+                # rows and invalidate last_kept's physical layout (which
+                # rollback_last applies positionally)
+                return jax.lax.cond(carry[2], lambda c: c,
+                                    functools.partial(_live_iter, t), carry)
+
+            def _live_iter(t, carry):
+                (p, recs, stopped, delta, last_kept) = carry
                 it = iter0 + t
+                # disjoint purpose-tagged key streams: fold a purpose
+                # constant (0=bagging, 1=feature, 2=GOSS) before the
+                # iteration number so no two draws share a subkey
                 if bag_on:
-                    bkey = jax.random.fold_in(key, 2 * (it // bag_freq))
+                    bkey = jax.random.fold_in(
+                        jax.random.fold_in(key, 0), it // bag_freq
+                    )
                     sel = jax.random.bernoulli(bkey, bag_frac, (n,)).astype(jnp.float32)
                 else:
                     sel = None
                 if used_features < F:
-                    fkey = jax.random.fold_in(key, 2 * it + 1)
+                    fkey = jax.random.fold_in(jax.random.fold_in(key, 1), it)
                     u = jax.random.uniform(fkey, (F,))
                     _, idx = jax.lax.top_k(u, used_features)
                     fmask = jnp.zeros((F,), jnp.float32).at[idx].set(1.0)
@@ -295,7 +309,7 @@ class PartitionedTrainer:
                         gscore = jnp.abs(gv * hv)
                         _, top_idx = jax.lax.top_k(gscore, top_cnt)
                         is_top = jnp.zeros((n,), bool).at[top_idx].set(True)
-                        gkey = jax.random.fold_in(key, 3 * it + 2)
+                        gkey = jax.random.fold_in(jax.random.fold_in(key, 2), it)
                         sampled = (~is_top) & (
                             jax.random.uniform(gkey, (n,)) < goss_prob
                         )
@@ -336,6 +350,10 @@ class PartitionedTrainer:
                     keep = ((tree.num_splits > 0) & (~stopped)).astype(jnp.float32)
                     lval = jnp.clip(lr * tree.leaf_value, -100.0, 100.0)
                     delta = segment_values(tree, n, keep * lval)
+                    # rollback needs the last KEPT tree's delta: an empty
+                    # tree zeroes the pending carry but must not clobber
+                    # what rollback_last would subtract
+                    last_kept = jnp.where(keep > 0, delta, last_kept)
                     any_split = tree.num_splits > 0
                     ns_t = ns_t.at[0].set(tree.num_splits)
                     raw_t = raw_t.at[0].set(tree.recs_raw)
@@ -377,15 +395,16 @@ class PartitionedTrainer:
                     "raw": recs["raw"].at[t].set(raw_t),
                 }
                 new_stopped = stopped | (~any_split)
-                return (p, recs, new_stopped, delta)
+                return (p, recs, new_stopped, delta, last_kept)
 
             m = L - 1
             recs0 = {
                 "num_splits": jnp.zeros((T, K), jnp.int32),
                 "raw": jnp.zeros((T, K, m, 12)),
             }
-            carry0 = (p, recs0, jnp.array(False), jnp.zeros((n,), jnp.float32))
-            p, recs, _, last_delta = jax.lax.fori_loop(
+            carry0 = (p, recs0, jnp.array(False), jnp.zeros((n,), jnp.float32),
+                      jnp.zeros((n,), jnp.float32))
+            p, recs, _, last_delta, last_kept = jax.lax.fori_loop(
                 0, jnp.minimum(t_run, T), one_iter, carry0
             )
             if K == 1:
@@ -404,7 +423,7 @@ class PartitionedTrainer:
                 sc = _i2f(p[lay.SCORE + k, :n])
                 outs.append(jnp.zeros((n,), jnp.float32).at[rowid].set(sc))
             scores_orig = outs[0] if K == 1 else jnp.stack(outs)
-            return p, recs, scores_orig, last_delta
+            return p, recs, scores_orig, last_kept
 
         return prog
 
@@ -438,15 +457,18 @@ class PartitionedTrainer:
             return {}, self.scores_original_order(), 0
         while remaining > 0:
             step = min(remaining, alloc)
-            self.p, recs, scores_orig, last_delta = prog(
+            self.p, recs, scores_orig, last_kept = prog(
                 self.p, jnp.float32(lr), self._base_key,
                 jnp.int32(iter0 + n_done), jnp.int32(step),
             )
-            self._last_tree = last_delta
             part = jax.device_get(recs)
             ns = part["num_splits"][:step]  # (step, K)
             stop = np.nonzero(np.all(ns == 0, axis=1))[0]
             done_here = int(stop[0]) if stop.size else step
+            if done_here > 0:
+                # last KEPT tree's settled delta (empty trees keep the
+                # previous chunk's value so rollback stays consistent)
+                self._last_tree = last_kept
             part = {k: v[:done_here] for k, v in part.items()}
             recs_np = part if recs_np is None else {
                 k: np.concatenate([recs_np[k], part[k]]) for k in part
@@ -560,8 +582,10 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
         sharding = NamedSharding(mesh, P("data"))
         if nproc > 1:
             gshape = (d, local.shape[1], local.shape[2])
+            # each per-device buffer keeps the leading shard axis: the
+            # (d, C, n) global array sharded on axis 0 has (1, C, n) shards
             bufs = [
-                _jax.device_put(local[i], dev)
+                _jax.device_put(local[i][None], dev)
                 for i, dev in enumerate(mesh.local_devices)
             ]
             self.p = _jax.make_array_from_single_device_arrays(gshape, sharding, bufs)
@@ -666,7 +690,8 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
                 return p[None]
 
             self._apply_prog = jax.jit(
-                self._shard_map(shard_body, (P("data"), P("data")), P("data"))
+                self._shard_map(shard_body, (P("data"), P("data")), P("data")),
+                donate_argnums=(0,),
             )
         dg = delta if hasattr(delta, "sharding") else self._make_row_global(delta)
         self.p = self._apply_prog(self.p, dg)
@@ -676,9 +701,38 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
         self._apply_delta(np.full((self.local_rows,), np.float32(c)))
 
     def sync_scores_from(self, scores_orig) -> None:
-        cur = self._gather_rows(self._scores_global())
-        target = np.asarray(scores_orig, np.float32)
-        self._apply_delta(target - cur)
+        """Bring score channels to an original-order target.  The delta
+        must be computed in PHYSICAL row order: split_stream permutes
+        shard columns, so the in-shard body gathers the row-order target
+        through the ROWID channel and subtracts the positional current
+        scores (mirrors the serial trainer's rowid gather)."""
+        from jax.sharding import PartitionSpec as P
+
+        if getattr(self, "_sync_prog", None) is None:
+            lay = self.layout
+            interp = self.interpret
+            params = self.params
+            nl = self.num_rows
+
+            def shard_body(pg, tg):
+                p = pg[0]
+                rowid = p[lay.ROWID, :nl]
+                cur = _i2f(p[lay.SCORE, :nl])
+                dphys = tg[rowid] - cur
+                p, _ = update_and_root_hist(
+                    p, lay, self._grad_fn, delta=dphys, num_rows=nl,
+                    num_features=(params.num_cols or params.num_features),
+                    num_bins=(params.num_bins_hist or params.num_bins),
+                    bits=params.bits, interpret=interp,
+                )
+                return p[None]
+
+            self._sync_prog = jax.jit(
+                self._shard_map(shard_body, (P("data"), P("data")), P("data")),
+                donate_argnums=(0,),
+            )
+        tg = self._make_row_global(np.asarray(scores_orig, np.float32))
+        self.p = self._sync_prog(self.p, tg)
         self.score_dirty = False
 
     def _scores_global(self):
@@ -730,19 +784,34 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
         G = params.num_cols or F
         BH = params.num_bins_hist or params.num_bins
 
-        def shard_body(pg, valid, lr, key, iter0, t_run):
+        def shard_body(pg, nreal_g, lr, key, iter0, t_run):
             p = pg[0]
             ax = jax.lax.axis_index("data")
+            nreal = nreal_g[0]  # this shard's real-row count
 
             def one_iter(t, carry):
-                (p, recs, stopped, delta) = carry
+                # post-stop iterations are full no-ops (see the serial
+                # trainer: a throwaway tree would repartition rows under
+                # the positionally-applied last_kept)
+                return jax.lax.cond(carry[2], lambda c: c,
+                                    functools.partial(_live_iter, t), carry)
+
+            def _live_iter(t, carry):
+                (p, recs, stopped, delta, last_kept) = carry
                 it = iter0 + t
                 if bag_on:
                     bkey = jax.random.fold_in(
-                        jax.random.fold_in(key, 2 * (it // bag_freq)), ax
+                        jax.random.fold_in(
+                            jax.random.fold_in(key, 0), it // bag_freq
+                        ), ax
                     )
                     sel = jax.random.bernoulli(bkey, bag_frac, (nl,)).astype(jnp.float32)
-                    sel = sel * valid  # shard-padding rows stay deselected
+                    # validity must travel WITH the row: split_stream
+                    # permutes shard columns, so padding is identified by
+                    # the preserved ROWID channel (local rowid >= nreal),
+                    # never by position
+                    valid = (p[lay.ROWID, :nl] < nreal).astype(jnp.float32)
+                    sel = sel * valid
                 else:
                     sel = None
                 p, root_hist = update_and_root_hist(
@@ -753,7 +822,7 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
                 root_hist = jax.lax.psum(root_hist, "data")
 
                 if used_features < F:
-                    fkey = jax.random.fold_in(key, 2 * it + 1)
+                    fkey = jax.random.fold_in(jax.random.fold_in(key, 1), it)
                     u = jax.random.uniform(fkey, (F,))
                     _, idx = jax.lax.top_k(u, used_features)
                     fmask = jnp.zeros((F,), jnp.float32).at[idx].set(1.0)
@@ -768,20 +837,22 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
                 keep = ((tree.num_splits > 0) & (~stopped)).astype(jnp.float32)
                 lval = jnp.clip(lr * tree.leaf_value, -100.0, 100.0)
                 delta_next = segment_values(tree, nl, keep * lval)
+                last_kept = jnp.where(keep > 0, delta_next, last_kept)
                 recs = {
                     "num_splits": recs["num_splits"].at[t, 0].set(tree.num_splits),
                     "raw": recs["raw"].at[t, 0].set(tree.recs_raw),
                 }
                 new_stopped = stopped | (tree.num_splits == 0)
-                return (p, recs, new_stopped, delta_next)
+                return (p, recs, new_stopped, delta_next, last_kept)
 
             m = L - 1
             recs0 = {
                 "num_splits": jnp.zeros((T, 1), jnp.int32),
                 "raw": jnp.zeros((T, 1, m, 12)),
             }
-            carry0 = (p, recs0, jnp.array(False), jnp.zeros((nl,), jnp.float32))
-            p, recs, _, last_delta = jax.lax.fori_loop(
+            carry0 = (p, recs0, jnp.array(False), jnp.zeros((nl,), jnp.float32),
+                      jnp.zeros((nl,), jnp.float32))
+            p, recs, _, last_delta, last_kept = jax.lax.fori_loop(
                 0, jnp.minimum(t_run, T), one_iter, carry0
             )
             p, _ = update_and_root_hist(
@@ -792,7 +863,7 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
             rowid = p[lay.ROWID, :nl]
             sc = _i2f(p[lay.SCORE, :nl])
             scores_local = jnp.zeros((nl,), jnp.float32).at[rowid].set(sc)
-            return p[None], recs, scores_local, last_delta
+            return p[None], recs, scores_local, last_kept
 
         mapped = self._shard_map(
             shard_body,
@@ -819,21 +890,39 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
         scores = None
         if T <= 0:
             return {}, self.scores_original_order(), 0
-        if not hasattr(self, "_valid_global"):
-            self._valid_global = self._make_row_global(
-                np.ones((self.local_rows,), np.float32)
-            )
+        if not hasattr(self, "_nreal_global"):
+            # per-shard real-row counts, one scalar per device
+            import jax as _jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            nl = self.num_rows
+            vals = np.asarray(
+                [max(0, min(self.local_rows - k * nl, nl))
+                 for k in range(self.d_local)], np.int32,
+            ).reshape(self.d_local, 1)
+            sharding = NamedSharding(self.mesh, P("data"))
+            if _jax.process_count() > 1:
+                bufs = [_jax.device_put(vals[i], dev)
+                        for i, dev in enumerate(self.mesh.local_devices)]
+                self._nreal_global = _jax.make_array_from_single_device_arrays(
+                    (self.d,), sharding, bufs
+                )
+            else:
+                self._nreal_global = _jax.device_put(
+                    jnp.asarray(vals.reshape(-1)), sharding
+                )
         while remaining > 0:
             step = min(remaining, alloc)
-            self.p, recs, scores, last_delta = prog(
-                self.p, self._valid_global, jnp.float32(lr), self._base_key,
+            self.p, recs, scores, last_kept = prog(
+                self.p, self._nreal_global, jnp.float32(lr), self._base_key,
                 jnp.int32(iter0 + n_done), jnp.int32(step),
             )
-            self._last_tree = last_delta
             part = jax.device_get(recs)
             ns = part["num_splits"][:step]  # (step, 1)
             stop = np.nonzero(np.all(ns == 0, axis=1))[0]
             done_here = int(stop[0]) if stop.size else step
+            if done_here > 0:
+                self._last_tree = last_kept
             part = {k: v[:done_here] for k, v in part.items()}
             recs_np = part if recs_np is None else {
                 k: np.concatenate([recs_np[k], part[k]]) for k in part

@@ -121,6 +121,51 @@ def train(
         booster.best_iteration = booster.current_iteration()
         return booster
 
+    # Fused path WITH eval: when an eval period > 1 is configured
+    # (output_freq, or an integer verbose_eval), run fused chunks of
+    # ``period`` iterations between eval points instead of dropping to
+    # one-dispatch-per-iteration; early stopping and the periodic
+    # callbacks consume chunk-boundary metrics.  (The reference's CLI
+    # evaluates at output_freq granularity the same way,
+    # application.cpp:225-250; the python API's per-iteration eval is
+    # preserved whenever period == 1.)
+    period = int(canon.get("output_freq", 1))
+    if isinstance(verbose_eval, int) and verbose_eval is not True and verbose_eval > 1:
+        period = max(period, int(verbose_eval))
+    if (
+        ptrainer is not None
+        and fobj is None
+        and not cbs_before
+        and period > 1
+    ):
+        i = 0
+        while i < num_boost_round:
+            step = min(period, num_boost_round - i)
+            iter_before = booster.boosting.iter
+            booster.boosting.train_iters_partitioned(step, is_eval=False)
+            done = booster.boosting.iter - iter_before
+            i += done
+            evaluation_result_list = []
+            if valid_sets is not None or eval_train:
+                if eval_train:
+                    evaluation_result_list.extend(booster.eval_train(feval))
+                evaluation_result_list.extend(booster.eval_valid(feval))
+            try:
+                for cb in cbs_after:
+                    cb(callback_mod.CallbackEnv(
+                        booster, params, i - 1, 0, num_boost_round,
+                        evaluation_result_list))
+            except callback_mod.EarlyStopException as es:
+                booster.best_iteration = es.best_iteration + 1
+                _record_best_score(booster, es.best_score)
+                break
+            if done < step:
+                Log.info("Finished training with %d iterations", i)
+                break
+        if booster.best_iteration <= 0:
+            booster.best_iteration = booster.current_iteration()
+        return booster
+
     # training loop
     for i in range(num_boost_round):
         for cb in cbs_before:

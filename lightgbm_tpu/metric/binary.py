@@ -7,13 +7,21 @@ from __future__ import annotations
 import numpy as np
 
 from .base import Metric, convert_scores
+from .device import (
+    DeviceEval,
+    _auc_dev,
+    _binary_error_dev,
+    _binary_logloss_dev,
+)
 
 _EPS = 1e-15
 
 
-class BinaryLoglossMetric(Metric):
+class BinaryLoglossMetric(DeviceEval, Metric):
     name = "binary_logloss"
     bigger_is_better = False
+    _dev_fn = staticmethod(_binary_logloss_dev)
+    _dev_needs_prob = True
 
     def __init__(self, config):
         pass
@@ -28,9 +36,11 @@ class BinaryLoglossMetric(Metric):
         return [(self.name, float(np.sum(pt) / self.sum_weights))]
 
 
-class BinaryErrorMetric(Metric):
+class BinaryErrorMetric(DeviceEval, Metric):
     name = "binary_error"
     bigger_is_better = False
+    _dev_fn = staticmethod(_binary_error_dev)
+    _dev_needs_prob = True
 
     def __init__(self, config):
         pass
@@ -45,12 +55,13 @@ class BinaryErrorMetric(Metric):
         return [(self.name, float(np.sum(err) / self.sum_weights))]
 
 
-class AUCMetric(Metric):
+class AUCMetric(DeviceEval, Metric):
     """Threshold-sweep AUC with tie grouping (binary_metric.hpp:193-259);
     raw scores — no sigmoid needed (monotone)."""
 
     name = "auc"
     bigger_is_better = True
+    _dev_fn = staticmethod(_auc_dev)
 
     def __init__(self, config):
         pass

@@ -1,0 +1,138 @@
+"""Device-side metric evaluation.
+
+The host metric path pulls the full (K, N) f64 score vector and sorts on
+host per eval point (metric/binary.py AUC mergesort) — at Higgs-11M with
+a valid set this rivals tree-build time and forces the fused trainer off
+its fast path.  These jnp twins keep scores device-resident and transfer
+ONE scalar per metric.  Counterpart of src/metric/binary_metric.hpp /
+regression_metric.hpp / multiclass_metric.hpp evaluated on-accelerator.
+
+Numerics: sums are f32 pairwise reductions (relative error ~1e-6 at 10M
+rows) against the host path's f64; the AUC tie handling is exact (the
+tie-grouped sweep below mirrors binary_metric.hpp:193-259 group order).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-15
+
+
+@jax.jit
+def _binary_logloss_dev(prob, label, weights, sum_weights):
+    lab_pos = label > 0
+    p = jnp.where(lab_pos, prob, 1.0 - prob)
+    pt = -jnp.log(jnp.maximum(p, _EPS))
+    return jnp.sum(pt * weights) / sum_weights
+
+
+@jax.jit
+def _binary_error_dev(prob, label, weights, sum_weights):
+    err = jnp.where(prob <= 0.5, label > 0, label <= 0).astype(jnp.float32)
+    return jnp.sum(err * weights) / sum_weights
+
+
+@jax.jit
+def _auc_dev(score, label, weights, sum_weights):
+    """Tie-grouped AUC (binary_metric.hpp:193-259) without host sorts.
+
+    Per sorted-descending row i: its negatives pair with all positives of
+    strictly-greater score plus half the positives of its own tie group.
+    Group boundaries propagate via running-max scans instead of the host
+    path's segment scatter."""
+    order = jnp.argsort(-score)
+    s = score[order]
+    lab = label[order]
+    w = weights[order]
+    pos = jnp.where(lab > 0, w, 0.0)
+    neg = jnp.where(lab <= 0, w, 0.0)
+    cum_pos = jnp.cumsum(pos)
+    cum_pos_excl = cum_pos - pos
+    n = s.shape[0]
+    new_thr = jnp.concatenate(
+        [jnp.ones((1,), bool), s[1:] != s[:-1]]
+    )
+    is_end = jnp.concatenate([s[1:] != s[:-1], jnp.ones((1,), bool)])
+    # positives before this row's tie group: the group-start exclusive
+    # cumsum, forward-propagated to every member (running max works
+    # because cum_pos_excl is nondecreasing)
+    start = jax.lax.cummax(jnp.where(new_thr, cum_pos_excl, -1.0))
+    # positives through the group end, propagated backward to members:
+    # cum_pos is nondecreasing, so the FIRST end at-or-after each row
+    # (this group's end) is the reversed running MIN over end sentinels
+    endv = jax.lax.cummin(
+        jnp.where(is_end, cum_pos, jnp.float32(jnp.inf)), reverse=True
+    )
+    pos_g = endv - start
+    accum = jnp.sum(neg * (start + 0.5 * pos_g))
+    sum_pos = cum_pos[n - 1]
+    denom = sum_pos * (sum_weights - sum_pos)
+    return jnp.where(denom > 0.0, accum / denom, 1.0)
+
+
+@jax.jit
+def _l2_dev(score, label, weights, sum_weights):
+    d = score - label
+    return jnp.sum(d * d * weights) / sum_weights
+
+
+@jax.jit
+def _l1_dev(score, label, weights, sum_weights):
+    return jnp.sum(jnp.abs(score - label) * weights) / sum_weights
+
+
+@jax.jit
+def _multi_logloss_dev(prob, label, weights, sum_weights):
+    """prob (K, N) softmax outputs; label (N,) class ids."""
+    k = prob.shape[0]
+    lab = jnp.clip(label.astype(jnp.int32), 0, k - 1)
+    p = jnp.take_along_axis(prob, lab[None, :], axis=0)[0]
+    pt = -jnp.log(jnp.maximum(p, _EPS))
+    return jnp.sum(pt * weights) / sum_weights
+
+
+@jax.jit
+def _multi_error_dev(prob, label, weights, sum_weights):
+    """Ties on the true class count as errors (>= sweep excluding the true
+    class itself — multiclass_metric.hpp:136-144; the host twin's ge
+    semantics, NOT argmax)."""
+    k = prob.shape[0]
+    lab = jnp.clip(label.astype(jnp.int32), 0, k - 1)
+    true_score = jnp.take_along_axis(prob, lab[None, :], axis=0)  # (1, N)
+    n_ge = jnp.sum((prob >= true_score).astype(jnp.int32), axis=0)
+    err = (n_ge > 1).astype(jnp.float32)  # the true class always counts once
+    return jnp.sum(err * weights) / sum_weights
+
+
+class DeviceEval:
+    """Mixin: device-resident twin of Metric.eval.
+
+    ``eval_device(score, objective)`` takes a DEVICE (N,)/(K, N) score
+    array and returns the same [(name, value)] contract with one scalar
+    transfer.  Metrics opt in by setting ``_dev_fn`` and (optionally)
+    ``_dev_needs_prob``."""
+
+    _dev_fn = None
+    _dev_needs_prob = False
+
+    def _dev_cached(self):
+        if not hasattr(self, "_dev_label"):
+            self._dev_label = jnp.asarray(self.label, jnp.float32)
+            if self.weights is not None:
+                self._dev_weights = jnp.asarray(self.weights, jnp.float32)
+            else:
+                self._dev_weights = jnp.ones((self.num_data,), jnp.float32)
+            self._dev_sum_w = jnp.float32(self.sum_weights)
+        return self._dev_label, self._dev_weights, self._dev_sum_w
+
+    def eval_device(self, score, objective=None):
+        fn = type(self)._dev_fn
+        if fn is None:
+            raise NotImplementedError
+        label, w, sw = self._dev_cached()
+        s = jnp.asarray(score, jnp.float32)
+        if self._dev_needs_prob and objective is not None:
+            s = objective.convert_output(s)
+        return [(self.name, float(fn(s, label, w, sw)))]

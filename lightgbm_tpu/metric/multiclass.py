@@ -7,6 +7,7 @@ from __future__ import annotations
 import numpy as np
 
 from .base import Metric, convert_scores
+from .device import DeviceEval, _multi_error_dev, _multi_logloss_dev
 
 _EPS = 1e-15
 
@@ -28,11 +29,13 @@ class _MulticlassMetric(Metric):
         return [(self.name, float(np.sum(pt) / self.sum_weights))]
 
 
-class MultiErrorMetric(_MulticlassMetric):
+class MultiErrorMetric(DeviceEval, _MulticlassMetric):
     """1 when any other class's score >= the true class's
     (multiclass_metric.hpp:136-144)."""
 
     name = "multi_error"
+    _dev_fn = staticmethod(_multi_error_dev)
+    _dev_needs_prob = True
 
     def loss(self, label, prob):
         k = label.astype(np.int64)
@@ -44,8 +47,10 @@ class MultiErrorMetric(_MulticlassMetric):
         return np.any(ge, axis=0).astype(np.float64)
 
 
-class MultiLoglossMetric(_MulticlassMetric):
+class MultiLoglossMetric(DeviceEval, _MulticlassMetric):
     name = "multi_logloss"
+    _dev_fn = staticmethod(_multi_logloss_dev)
+    _dev_needs_prob = True
 
     def loss(self, label, prob):
         k = label.astype(np.int64)

@@ -7,6 +7,7 @@ from __future__ import annotations
 import numpy as np
 
 from .base import Metric, convert_scores
+from .device import DeviceEval, _l1_dev, _l2_dev
 
 _EPS = 1e-15
 
@@ -32,8 +33,9 @@ class _RegressionMetric(Metric):
         return [(self.name, float(self.average(float(np.sum(pt)), self.sum_weights)))]
 
 
-class L2Metric(_RegressionMetric):
+class L2Metric(DeviceEval, _RegressionMetric):
     name = "l2"
+    _dev_fn = staticmethod(_l2_dev)
 
     def loss(self, label, score):
         d = score - label
@@ -46,9 +48,14 @@ class RMSEMetric(L2Metric):
     def average(self, sum_loss, sum_weights):
         return np.sqrt(sum_loss / sum_weights)
 
+    def eval_device(self, score, objective=None):
+        [(name, val)] = super().eval_device(score, objective)
+        return [(self.name, float(np.sqrt(val)))]
 
-class L1Metric(_RegressionMetric):
+
+class L1Metric(DeviceEval, _RegressionMetric):
     name = "l1"
+    _dev_fn = staticmethod(_l1_dev)
 
     def loss(self, label, score):
         return np.abs(score - label)

@@ -32,12 +32,20 @@ Design notes (v2, measured on v5e):
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 
-from .pkernels import BLK, PLayout, hist_dyn, split_stream
+from .pkernels import (
+    BLK,
+    PLayout,
+    _hist_from_rows,
+    hist_dyn,
+    level_stream,
+    split_stream,
+)
 from .split import (
     NEG_INF,
     FeatureMeta,
@@ -133,6 +141,8 @@ class _PState(NamedTuple):
     leaf: jnp.ndarray  # (L, 8) f32 [sum_g, sum_h, sum_c, value, cnt, depth, 0, 0]
     recs: jnp.ndarray  # (L-1, 12) f32 [leaf, feat, thr, dbz, gain, lval,
     #                                   rval, lcnt, rcnt, ival, 0, 0]
+    pslot: jnp.ndarray  # (L,) i32 candidate-table slot of each pool leaf
+    #   (>= 0: node came from the level-batched expansion; -1: classic)
 
 
 def _meta_table(meta: FeatureMeta, bmeta, f: int, bits: int) -> jnp.ndarray:
@@ -172,7 +182,21 @@ def grow_tree_partitioned(
     Returns (PTreeResult, p').  ``p`` arrives with the g/h/sel channels
     freshly written for this tree; row ORDER is whatever the previous
     tree left (irrelevant — the root segment is always the full
-    [0, num_rows) range and histograms are order-invariant)."""
+    [0, num_rows) range and histograms are order-invariant).
+
+    Two-phase growth (v3): per-split kernel launches cost ~0.3 ms of
+    fixed overhead on the tunneled runtime — 2/3 of a 255-leaf iteration
+    — so phase 1 expands the tree LEVEL-batched (one ``level_stream``
+    launch partitions every active segment and emits all children
+    histograms; one vmapped split-search per level), then phase 2 replays
+    the reference's EXACT best-first selection (SerialTreeLearner::Train's
+    argmax-over-leaves order, including the leaf-id tie order) as a cheap
+    bookkeeping loop over the precomputed candidate tables.  Nodes the
+    selection wants beyond the expanded depth fall back to the classic
+    per-split ``split_stream`` path in the same loop.  The final tree is
+    identical to the per-split grower's; only the kernel-launch count
+    changes (~levels instead of ~num_leaves).  Set
+    LIGHTGBM_TPU_LEVELGROW=0 to force the classic path."""
     L = params.num_leaves
     F = params.num_features
     B = params.num_bins
@@ -187,9 +211,10 @@ def grow_tree_partitioned(
         rows = PLayout(G, bits=params.bits).rows
     per = 32 // params.bits
     mtab = _meta_table(meta, bmeta, F, params.bits)
+    levelwise = os.environ.get("LIGHTGBM_TPU_LEVELGROW", "1") != "0" and L > 4
 
     def find2(hist2, sums2, depth_ok):
-        """Best split for two sibling leaves at once: hist2 (2, G/F, B, 3),
+        """Best split for sibling leaves at once: hist2 (2, G/F, B, 3),
         sums2 (2, 3) -> per-leaf scalars stacked on axis 0."""
         if bundled:
             hist2 = jax.vmap(
@@ -217,19 +242,139 @@ def grow_tree_partitioned(
                jnp.stack([root_sums, root_sums]), jnp.array(True))
 
     root_val = leaf_output(root_sums[0], root_sums[1], hyper.lambda_l1, hyper.lambda_l2)
+    root_bs = jnp.stack([rr.gain[0], rr.feature[0].astype(jnp.float32),
+                         rr.threshold_bin[0].astype(jnp.float32),
+                         rr.default_bin_for_zero[0].astype(jnp.float32),
+                         rr.left_sum_g[0], rr.left_sum_h[0], rr.left_cnt[0],
+                         jnp.float32(0.0)])
+    root_leaf = jnp.stack([root_sums[0], root_sums[1], root_sums[2], root_val,
+                           root_sums[2], jnp.float32(0.0), jnp.float32(0.0),
+                           jnp.float32(0.0)])
     seg0 = jnp.zeros((L, 2), jnp.int32).at[0, 1].set(n)
-    bs0 = jnp.full((L, 8), NEG_INF, jnp.float32).at[0].set(
-        jnp.stack([rr.gain[0], rr.feature[0].astype(jnp.float32),
-                   rr.threshold_bin[0].astype(jnp.float32),
-                   rr.default_bin_for_zero[0].astype(jnp.float32),
-                   rr.left_sum_g[0], rr.left_sum_h[0], rr.left_cnt[0],
-                   jnp.float32(0.0)])
-    )
-    leaf0 = jnp.zeros((L, 8), jnp.float32).at[0].set(
-        jnp.stack([root_sums[0], root_sums[1], root_sums[2], root_val,
-                   root_sums[2], jnp.float32(0.0), jnp.float32(0.0),
-                   jnp.float32(0.0)])
-    )
+    bs0 = jnp.full((L, 8), NEG_INF, jnp.float32).at[0].set(root_bs)
+    leaf0 = jnp.zeros((L, 8), jnp.float32).at[0].set(root_leaf)
+
+    # ---- phase 1: level-batched expansion into candidate tables ------
+    if levelwise:
+        SMAX = min(-(-(L + 1) // 8) * 8, 512)
+        CANDMAX = 2 * SMAX
+        MAXLVL = int(os.environ.get("LIGHTGBM_TPU_MAXLVL", "24"))
+        c_seg0 = jnp.zeros((CANDMAX, 2), jnp.int32).at[0, 1].set(n)
+        c_bs0 = jnp.full((CANDMAX, 8), NEG_INF, jnp.float32).at[0].set(root_bs)
+        c_leaf0 = jnp.zeros((CANDMAX, 8), jnp.float32).at[0].set(root_leaf)
+        c_childlo0 = jnp.full((CANDMAX,), -1, jnp.int32)
+        frontier0 = jnp.zeros((SMAX,), jnp.int32)  # slot 0 = root
+
+        def lcond(s):
+            return (s[7] > 0) & (s[8] < MAXLVL)
+
+        def lbody(s):
+            (p, c_seg, c_bs, c_leaf, c_childlo, cand_n, frontier,
+             frontier_n, level) = s
+            idx = jnp.arange(SMAX)
+            fvalid = idx < frontier_n
+            fslots = jnp.clip(frontier, 0, CANDMAX - 1)
+            gains = jnp.where(fvalid, c_bs[fslots, 0], NEG_INF)
+            active = gains > 0.0
+            # cap: children must fit both the frontier array and the
+            # candidate table; dropped nodes stay splittable via the
+            # phase-2 classic tail
+            n_act = jnp.minimum(jnp.sum(active.astype(jnp.int32)), SMAX // 2)
+            n_act = jnp.minimum(n_act, jnp.maximum((CANDMAX - cand_n) // 2, 0))
+            # compact active slots to the front (stable frontier order)
+            order = jnp.argsort(jnp.where(active, 0, 1), stable=True)
+            aslots = fslots[order]
+            arow = idx < n_act
+            segs = c_seg[aslots]  # (SMAX, 2)
+            bsr = c_bs[aslots]
+            feat = jnp.clip(bsr[:, 1].astype(jnp.int32), 0, F - 1)
+            thr = bsr[:, 2].astype(jnp.int32)
+            dbz = bsr[:, 3].astype(jnp.int32)
+            mrows = mtab[feat]
+            col = mrows[:, 2].astype(jnp.int32)
+            seg_tab = jnp.stack([
+                segs[:, 0], jnp.where(arow, segs[:, 1], 0),
+                col // per, (col % per) * params.bits,
+                mrows[:, 0].astype(jnp.int32), dbz, thr,
+                mrows[:, 1].astype(jnp.int32),
+                mrows[:, 3].astype(jnp.int32), mrows[:, 4].astype(jnp.int32),
+                mrows[:, 5].astype(jnp.int32), jnp.zeros_like(col),
+            ], axis=1)
+            p, nl, hists = level_stream(
+                p, seg_tab, n_act, num_features=G, num_bins=BH,
+                bits=params.bits, rows=rows, smax=SMAX, interpret=interpret,
+            )
+            if params.axis_name:
+                # ONE collective per level (vs per split): global children
+                # histograms keep the tree bit-identical on every device
+                hists = jax.lax.psum(
+                    jnp.where(arow[:, None, None], hists, 0.0), params.axis_name
+                )
+            lsums = bsr[:, 4:7]
+            tots = c_leaf[aslots][:, 0:3]
+            rsums = tots - lsums
+            cdepth = c_leaf[aslots][:, 5] + 1.0
+            hist_l = jax.vmap(lambda h: _hist_from_rows(h, G, BH, row0=0))(hists)
+            hist_r = jax.vmap(lambda h: _hist_from_rows(h, G, BH, row0=7))(hists)
+            hist2 = jnp.stack([hist_l, hist_r], axis=1)  # (SMAX, 2, G, BH, 3)
+            sums2 = jnp.stack([lsums, rsums], axis=1)  # (SMAX, 2, 3)
+            dok2 = (jnp.ones((SMAX, 2), bool) if params.max_depth <= 0
+                    else jnp.stack([cdepth < params.max_depth] * 2, axis=1))
+            res = jax.vmap(find2)(hist2, sums2, dok2)  # fields (SMAX, 2)
+            vals2 = leaf_output(sums2[..., 0], sums2[..., 1],
+                                hyper.lambda_l1, hyper.lambda_l2)  # (SMAX, 2)
+            il = jnp.where(arow, cand_n + 2 * idx, CANDMAX)
+            ir = jnp.where(arow, cand_n + 2 * idx + 1, CANDMAX)
+            seg_l = jnp.stack([segs[:, 0], nl], axis=1)
+            seg_r = jnp.stack([segs[:, 0] + nl, segs[:, 1] - nl], axis=1)
+            c_seg = (c_seg.at[il].set(seg_l, mode="drop")
+                     .at[ir].set(seg_r, mode="drop"))
+
+            def bs_rows(k):
+                return jnp.stack([
+                    res.gain[:, k], res.feature[:, k].astype(jnp.float32),
+                    res.threshold_bin[:, k].astype(jnp.float32),
+                    res.default_bin_for_zero[:, k].astype(jnp.float32),
+                    res.left_sum_g[:, k], res.left_sum_h[:, k],
+                    res.left_cnt[:, k], jnp.zeros((SMAX,), jnp.float32),
+                ], axis=1)
+
+            c_bs = (c_bs.at[il].set(bs_rows(0), mode="drop")
+                    .at[ir].set(bs_rows(1), mode="drop"))
+
+            def leaf_rows(k):
+                z = jnp.zeros((SMAX,), jnp.float32)
+                return jnp.stack([
+                    sums2[:, k, 0], sums2[:, k, 1], sums2[:, k, 2],
+                    vals2[:, k], sums2[:, k, 2], cdepth, z, z,
+                ], axis=1)
+
+            c_leaf = (c_leaf.at[il].set(leaf_rows(0), mode="drop")
+                      .at[ir].set(leaf_rows(1), mode="drop"))
+            par = jnp.where(arow, aslots, CANDMAX)
+            c_childlo = c_childlo.at[par].set(
+                jnp.where(arow, il, -1), mode="drop")
+            children = jnp.clip(
+                jnp.stack([il, ir], axis=1).reshape(-1)[:SMAX], 0, CANDMAX - 1
+            )
+            return (p, c_seg, c_bs, c_leaf, c_childlo, cand_n + 2 * n_act,
+                    children, 2 * n_act, level + 1)
+
+        (p, c_seg, c_bs, c_leaf, c_childlo, _, _, _, _) = jax.lax.while_loop(
+            lcond, lbody,
+            (p, c_seg0, c_bs0, c_leaf0, c_childlo0, jnp.int32(1), frontier0,
+             jnp.int32(1), jnp.int32(0)),
+        )
+        pslot0 = jnp.full((L,), -1, jnp.int32).at[0].set(0)
+    else:
+        CANDMAX = 1
+        c_seg = jnp.zeros((1, 2), jnp.int32)
+        c_bs = jnp.zeros((1, 8), jnp.float32)
+        c_leaf = jnp.zeros((1, 8), jnp.float32)
+        c_childlo = jnp.full((1,), -1, jnp.int32)
+        pslot0 = jnp.full((L,), -1, jnp.int32)
+
+    # ---- phase 2: exact best-first selection ------------------------
     st = _PState(
         p=p,
         num_splits=jnp.int32(0),
@@ -238,6 +383,7 @@ def grow_tree_partitioned(
         bs=bs0,
         leaf=leaf0,
         recs=jnp.zeros((L - 1, 12), jnp.float32),
+        pslot=pslot0,
     )
 
     def cond(st: _PState):
@@ -265,59 +411,78 @@ def grow_tree_partitioned(
         segrow = st.seg[bl]
         start = segrow[0]
         cnt = segrow[1]
-        mrow = mtab[feat]
-        zb = mrow[0].astype(jnp.int32)
-        cat = mrow[1].astype(jnp.int32)
-        colidx = mrow[2].astype(jnp.int32)
-        off_lo = mrow[3].astype(jnp.int32)
-        off_hi = mrow[4].astype(jnp.int32)
-        bias = mrow[5].astype(jnp.int32)
+        slot = st.pslot[bl]
+        childlo = c_childlo[jnp.clip(slot, 0, CANDMAX - 1)]
+        has_pre = (slot >= 0) & (childlo >= 0)
 
-        p, nl, lhist, rhist = split_stream(
-            st.p, start, cnt,
-            colidx // per, (colidx % per) * params.bits, zb, dbz, thr, cat,
-            off_lo=off_lo, off_hi=off_hi, bias=bias,
-            num_features=G, num_bins=BH, bits=params.bits, rows=rows,
-            interpret=interpret,
+        def take_pre(p):
+            clo = jnp.clip(childlo, 0, CANDMAX - 1)
+            chi = jnp.clip(childlo + 1, 0, CANDMAX - 1)
+            seg2 = jnp.stack([c_seg[clo], c_seg[chi]])
+            bs2 = jnp.stack([c_bs[clo], c_bs[chi]])
+            leaf2 = jnp.stack([c_leaf[clo], c_leaf[chi]])
+            ps2 = jnp.stack([clo, chi])
+            return p, seg2, bs2, leaf2, ps2
+
+        def take_classic(p):
+            mrow = mtab[feat]
+            zb = mrow[0].astype(jnp.int32)
+            cat = mrow[1].astype(jnp.int32)
+            colidx = mrow[2].astype(jnp.int32)
+            off_lo = mrow[3].astype(jnp.int32)
+            off_hi = mrow[4].astype(jnp.int32)
+            bias = mrow[5].astype(jnp.int32)
+            p, nl, lhist, rhist = split_stream(
+                p, start, cnt,
+                colidx // per, (colidx % per) * params.bits, zb, dbz, thr, cat,
+                off_lo=off_lo, off_hi=off_hi, bias=bias,
+                num_features=G, num_bins=BH, bits=params.bits, rows=rows,
+                interpret=interpret,
+            )
+            hist2 = jnp.stack([lhist, rhist])
+            if params.axis_name:
+                # global children histograms; the split decision below is
+                # then bit-identical on every device (local segments
+                # diverge, the tree does not)
+                hist2 = jax.lax.psum(hist2, params.axis_name)
+
+            right = totals - left
+            sums2 = jnp.stack([left, right])  # (2, 3)
+            vals2 = leaf_output(sums2[:, 0], sums2[:, 1], hyper.lambda_l1,
+                                hyper.lambda_l2)  # (2,)
+            depth_ok = (
+                jnp.array(True)
+                if params.max_depth <= 0
+                else child_depth < params.max_depth
+            )
+            res2 = find2(hist2, sums2, depth_ok)
+
+            seg2 = jnp.stack(
+                [jnp.stack([start, nl]), jnp.stack([start + nl, cnt - nl])]
+            )
+            bs2 = jnp.stack(
+                [res2.gain, res2.feature.astype(jnp.float32),
+                 res2.threshold_bin.astype(jnp.float32),
+                 res2.default_bin_for_zero.astype(jnp.float32),
+                 res2.left_sum_g, res2.left_sum_h, res2.left_cnt,
+                 jnp.zeros((2,), jnp.float32)], axis=1
+            )  # (2, 8)
+            leaf2 = jnp.stack(
+                [sums2[:, 0], sums2[:, 1], sums2[:, 2], vals2, sums2[:, 2],
+                 jnp.full((2,), child_depth),
+                 jnp.zeros((2,)), jnp.zeros((2,))], axis=1
+            )  # (2, 8)
+            ps2 = jnp.full((2,), -1, jnp.int32)
+            return p, seg2, bs2, leaf2, ps2
+
+        p, seg2, bs2, leaf2, ps2 = jax.lax.cond(
+            has_pre, take_pre, take_classic, st.p
         )
-        hist2 = jnp.stack([lhist, rhist])
-        if params.axis_name:
-            # global children histograms; the split decision below is then
-            # bit-identical on every device (local segments diverge, the
-            # tree does not)
-            hist2 = jax.lax.psum(hist2, params.axis_name)
-
-        right = totals - left
-        sums2 = jnp.stack([left, right])  # (2, 3)
-        vals2 = leaf_output(sums2[:, 0], sums2[:, 1], hyper.lambda_l1,
-                            hyper.lambda_l2)  # (2,)
-        depth_ok = (
-            jnp.array(True)
-            if params.max_depth <= 0
-            else child_depth < params.max_depth
-        )
-        res2 = find2(hist2, sums2, depth_ok)
-
         idx2 = jnp.stack([bl, rl])
-        seg2 = jnp.stack(
-            [jnp.stack([start, nl]), jnp.stack([start + nl, cnt - nl])]
-        )
-        bs2 = jnp.stack(
-            [res2.gain, res2.feature.astype(jnp.float32),
-             res2.threshold_bin.astype(jnp.float32),
-             res2.default_bin_for_zero.astype(jnp.float32),
-             res2.left_sum_g, res2.left_sum_h, res2.left_cnt,
-             jnp.zeros((2,), jnp.float32)], axis=1
-        )  # (2, 8)
-        leaf2 = jnp.stack(
-            [sums2[:, 0], sums2[:, 1], sums2[:, 2], vals2, sums2[:, 2],
-             jnp.full((2,), child_depth),
-             jnp.zeros((2,)), jnp.zeros((2,))], axis=1
-        )  # (2, 8)
         rec = jnp.stack(
             [bl.astype(jnp.float32), feat.astype(jnp.float32),
              thr.astype(jnp.float32), dbz.astype(jnp.float32), gain,
-             vals2[0], vals2[1], left[2], right[2], pval,
+             leaf2[0, 3], leaf2[1, 3], leaf2[0, 2], leaf2[1, 2], pval,
              jnp.float32(0.0), jnp.float32(0.0)]
         )
 
@@ -328,6 +493,7 @@ def grow_tree_partitioned(
             bs=st.bs.at[idx2].set(bs2),
             leaf=st.leaf.at[idx2].set(leaf2),
             recs=st.recs.at[s].set(rec),
+            pslot=st.pslot.at[idx2].set(ps2),
         )
 
     st = jax.lax.while_loop(cond, body, st)

@@ -1,0 +1,99 @@
+"""Measure the reference binary's same-box CPU sec/iteration and record
+it in BASELINE.json "published".
+
+BASELINE.md's wall-clock numbers exist only as an external chart image
+(docs/GPU-Performance.md:150), so the only measurable same-box anchor is
+the reference CPU build (refbuild/lightgbm, built from /root/reference by
+tests/golden/make_goldens.sh's recipe) on the bench harness's own 1M
+synthetic at the benchmark config (max_bin=63, num_leaves=255).
+
+Protocol: wall-clock a LONG run (50 iters) and a SHORT run (2 iters) with
+identical data/config; (long - short) / 48 removes data loading/binning
+from the per-iteration number.  NOTE this box exposes a single CPU core
+(nproc=1); the published reference numbers are 28-thread, so the stored
+value is labeled with the thread count and is NOT comparable to the
+28-core figures — bench.py reports it as "vs_ref_cpu_same_box" alongside
+(not replacing) the chart-derived GPU estimate.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+BIN = os.path.join(ROOT, "refbuild", "lightgbm")
+TRAIN = os.path.join(ROOT, "refbuild", "bench_1m.train")
+
+CONF = [
+    "task=train",
+    "objective=binary",
+    "data=" + TRAIN,
+    "max_bin=63",
+    "num_leaves=255",
+    "learning_rate=0.1",
+    "min_data_in_leaf=1",
+    "min_sum_hessian_in_leaf=100",
+    "verbosity=-1",
+    "is_training_metric=false",
+    "output_model=/dev/null",
+]
+
+
+def ensure_inputs():
+    if not os.path.exists(BIN):
+        sys.exit(f"missing {BIN} — build with tests/golden/make_goldens.sh recipe")
+    if not os.path.exists(TRAIN):
+        sys.path.insert(0, ROOT)
+        import numpy as np
+        import pandas as pd
+
+        from bench import make_higgs_shaped
+
+        X, y = make_higgs_shaped(1_000_000, seed=7)
+        pd.DataFrame(np.column_stack([y, X])).to_csv(
+            TRAIN, sep="\t", header=False, index=False, float_format="%.6g"
+        )
+
+
+def timed_run(num_trees: int, threads: int) -> float:
+    t0 = time.time()
+    subprocess.run(
+        [BIN] + CONF + [f"num_trees={num_trees}", f"num_threads={threads}"],
+        check=True, capture_output=True,
+    )
+    return time.time() - t0
+
+
+def main():
+    ensure_inputs()
+    threads = int(os.environ.get("BASELINE_THREADS", os.cpu_count() or 1))
+    long_n = int(os.environ.get("BASELINE_ITERS", 50))
+    short_n = 2
+    t_short = timed_run(short_n, threads)
+    t_long = timed_run(long_n, threads)
+    sec_per_iter = (t_long - t_short) / (long_n - short_n)
+    print(f"short({short_n})={t_short:.1f}s long({long_n})={t_long:.1f}s "
+          f"-> {sec_per_iter:.4f} s/iter @ {threads} threads")
+
+    path = os.path.join(ROOT, "BASELINE.json")
+    with open(path) as f:
+        base = json.load(f)
+    base.setdefault("published", {})
+    base["published"].update({
+        "ref_cpu_sec_per_iter_1m_rows": round(sec_per_iter, 4),
+        "ref_cpu_threads": threads,
+        "ref_cpu_iters_timed": long_n - short_n,
+        "ref_cpu_note": (
+            "same-box CPU measurement on bench.py's 1M synthetic; this box "
+            "has nproc=1 so NOT comparable to the 28-thread published runs"
+        ),
+    })
+    with open(path, "w") as f:
+        json.dump(base, f, indent=2)
+    print(f"recorded in {path}")
+
+
+if __name__ == "__main__":
+    main()

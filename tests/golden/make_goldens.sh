@@ -17,8 +17,11 @@ run() { # name confdir extra...
   local wd=$(mktemp -d)
   cp "$EX/$dir/"*.train "$EX/$dir/"*.test "$wd/" 2>/dev/null || true
   cp "$EX/$dir/"*.query "$wd/" 2>/dev/null || true
+  # FULL per-iteration metric trace: the parity suite compares our
+  # iteration-by-iteration valid metrics against the reference's, not
+  # just the final value
   (cd "$wd" && "$BIN" config="$EX/$dir/train.conf" $DET "$@" \
-      output_model="$OUT/${name}_model.txt" 2>&1 | grep -E "Iteration:(30|29)," | tail -4 \
+      output_model="$OUT/${name}_model.txt" 2>&1 | grep -E "Iteration:[0-9]+," \
       > "$OUT/${name}_train_metrics.txt")
   (cd "$wd" && "$BIN" config="$EX/$dir/predict.conf" \
       input_model="$OUT/${name}_model.txt" \

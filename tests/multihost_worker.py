@@ -67,6 +67,39 @@ if mode == "findbin":
     print(f"rank {rank} findbin done: {len(ds.bin_mappers)} mappers")
     sys.exit(0)
 
+if mode == "ptrainer":
+    # fused data-parallel trainer (ShardedPartitionedTrainer) across two
+    # processes: each rank holds a DIFFERENT row half (pre_partition);
+    # integer-valued features make the distributed find-bin mappers
+    # bit-identical to single-process full-data mappers, so the test can
+    # assert tree-for-tree parity against the serial fused trainer.
+    os.environ["LIGHTGBM_TPU_PGROW"] = "force"
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(5)
+    N, F = 3000, 6
+    X = rng.integers(0, 12, size=(N, F)).astype(np.float32)
+    wv = rng.standard_normal(F)
+    yp = 1.0 / (1.0 + np.exp(-((X - 6) @ wv * 0.3)))
+    y = (rng.random(N) < yp).astype(np.float32)
+    cut = 1700  # unequal halves exercise the shard-padding branches
+    sl = slice(0, cut) if rank == 0 else slice(cut, N)
+    p = dict(objective="binary", tree_learner="data", num_machines=2,
+             pre_partition=True, num_leaves=15, learning_rate=0.2,
+             max_bin=31, min_data_in_leaf=20, verbose=-1)
+    ds = lgb.Dataset(X[sl], label=y[sl], params=dict(p))
+    bst = lgb.train(p, ds, 4, verbose_eval=False)
+    from lightgbm_tpu.boosting.ptrainer import ShardedPartitionedTrainer
+
+    assert isinstance(bst.boosting.ptrainer, ShardedPartitionedTrainer), (
+        type(bst.boosting.ptrainer)
+    )
+    if rank == 0:
+        with open(out, "w") as fh:
+            fh.write(bst.model_to_string())
+    print(f"rank {rank} ptrainer done: {bst.num_trees} trees")
+    sys.exit(0)
+
 # identical synthetic dataset on both ranks; each passes its own half
 rng = np.random.default_rng(42)
 N, F, B = 4096, 6, 16

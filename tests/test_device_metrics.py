@@ -1,0 +1,89 @@
+"""Device-metric twins (metric/device.py) must match the host metrics.
+
+The host implementations are the parity-verified reference twins
+(binary_metric.hpp / regression_metric.hpp / multiclass_metric.hpp);
+the device versions exist so eval points keep scores device-resident
+(VERDICT r4 weak-7).  Tie handling in AUC is exercised via rounded
+scores (many exact duplicates)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.metric.binary import (
+    AUCMetric,
+    BinaryErrorMetric,
+    BinaryLoglossMetric,
+)
+from lightgbm_tpu.metric.multiclass import MultiErrorMetric, MultiLoglossMetric
+from lightgbm_tpu.metric.regression import L1Metric, L2Metric, RMSEMetric
+from lightgbm_tpu.objective.binary import BinaryLogloss
+from lightgbm_tpu.objective.multiclass import MulticlassSoftmax
+
+
+class _Meta:
+    pass
+
+
+def _check(metric, score, objective, rtol=2e-5):
+    (_, host) = metric.eval(np.asarray(score, np.float64), objective)[0]
+    (_, dev) = metric.eval_device(score, objective)[0]
+    assert dev == pytest.approx(host, rel=rtol, abs=1e-6)
+
+
+@pytest.mark.parametrize("weighted", [False, True])
+def test_binary_device_metrics_match_host(rng, weighted):
+    n = 20_000
+    score = np.round(rng.standard_normal(n), 2).astype(np.float32)  # ties
+    meta = _Meta()
+    meta.label = (rng.random(n) < 0.4).astype(np.float64)
+    meta.weights = rng.random(n) + 0.5 if weighted else None
+    cfg = Config()
+    obj = BinaryLogloss(cfg)
+    for cls in (AUCMetric, BinaryLoglossMetric, BinaryErrorMetric):
+        m = cls(cfg)
+        m.init(meta, n)
+        _check(m, score, obj)
+
+
+def test_regression_device_metrics_match_host(rng):
+    n = 20_000
+    score = rng.standard_normal(n).astype(np.float32)
+    meta = _Meta()
+    meta.label = rng.standard_normal(n)
+    meta.weights = rng.random(n) + 0.5
+    cfg = Config()
+    for cls in (L2Metric, RMSEMetric, L1Metric):
+        m = cls(cfg)
+        m.init(meta, n)
+        _check(m, score, None)
+
+
+def test_multiclass_device_metrics_match_host(rng):
+    n = 20_000
+    cfg = Config(num_class=5)
+    obj = MulticlassSoftmax(cfg)
+    # quantized scores force exact cross-class ties: multi_error counts a
+    # tie on the true class as an error (>= sweep), which argmax would miss
+    score = np.round(rng.standard_normal((5, n)), 1).astype(np.float32)
+    meta = _Meta()
+    meta.label = rng.randint(0, 5, n).astype(np.float64)
+    meta.weights = None
+    for cls in (MultiLoglossMetric, MultiErrorMetric):
+        m = cls(cfg)
+        m.init(meta, n)
+        _check(m, score, obj, rtol=5e-5)
+
+
+def test_auc_device_all_positive_edge(rng):
+    """denominator 0 -> reference returns 1.0 (binary_metric.hpp:249)."""
+    n = 256
+    meta = _Meta()
+    meta.label = np.ones(n)
+    meta.weights = None
+    m = AUCMetric(Config())
+    m.init(meta, n)
+    score = rng.standard_normal(n).astype(np.float32)
+    (_, host) = m.eval(np.asarray(score, np.float64))[0]
+    (_, dev) = m.eval_device(score)[0]
+    assert host == 1.0 and dev == 1.0

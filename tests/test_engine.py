@@ -179,6 +179,89 @@ def test_early_stopping(binary_data):
     assert bst.best_iteration <= 200
 
 
+def test_fused_chunked_eval_path(binary_data, monkeypatch):
+    """engine.train's fused-chunks-between-eval-points path (taken when
+    output_freq > 1 and the partitioned trainer is active): must produce
+    the same model quality as the per-iteration loop and honor early
+    stopping at chunk boundaries."""
+    monkeypatch.setenv("LIGHTGBM_TPU_PGROW", "force")
+    X, y, Xt, yt = binary_data
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "output_freq": 8, "verbose": -1}
+    ds = lgb.Dataset(X, label=y)
+    evals = {}
+    bst = lgb.train(
+        params, ds, num_boost_round=32,
+        valid_sets=[lgb.Dataset(Xt, label=yt, reference=ds)],
+        early_stopping_rounds=16, verbose_eval=False, evals_result=evals,
+    )
+    assert bst.boosting.ptrainer is not None  # fused trainer engaged
+    # eval happened at chunk boundaries only
+    n_evals = len(evals["valid_0"]["binary_logloss"])
+    assert 1 <= n_evals <= 4
+    # quality matches the classic per-iteration path at the same budget
+    monkeypatch.setenv("LIGHTGBM_TPU_PGROW", "0")
+    ref = lgb.train(dict(params, output_freq=1), lgb.Dataset(X, label=y),
+                    num_boost_round=bst.current_iteration(),
+                    verbose_eval=False)
+    from sklearn.metrics import log_loss
+    ll_fused = log_loss(yt, bst.predict(Xt))
+    ll_ref = log_loss(yt, ref.predict(Xt))
+    assert ll_fused == pytest.approx(ll_ref, rel=0.15, abs=0.02)
+
+
+def test_pandas_categorical_auto_detection():
+    """DataFrame ``category`` dtype columns become categorical features
+    under categorical_feature="auto" (reference python-package pandas
+    handling), survive model round-trips, and map predict-time category
+    orders through the training levels."""
+    pd = pytest.importorskip("pandas")
+    rng = np.random.default_rng(11)
+    n = 1200
+    cats = np.array(["red", "green", "blue", "teal"])
+    cat_col = cats[rng.integers(0, 4, n)]
+    x1 = rng.standard_normal(n)
+    # the categorical column carries most of the signal
+    y = ((cat_col == "green") | (cat_col == "teal")).astype(float)
+    y = np.where(rng.random(n) < 0.05, 1 - y, y)
+    df = pd.DataFrame({"c": pd.Categorical(cat_col), "x1": x1})
+    params = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 20,
+              "verbose": -1}
+    bst = lgb.train(params, lgb.Dataset(df, label=y), num_boost_round=10,
+                    verbose_eval=False)
+
+    def find_types(node, acc):
+        if "split_feature" in node:
+            acc.append((node["split_feature"], node["decision_type"]))
+            find_types(node["left_child"], acc)
+            find_types(node["right_child"], acc)
+
+    splits = []
+    for t in bst.dump_model()["tree_info"]:
+        find_types(t["tree_structure"], splits)
+    assert any(f == 0 and d == "==" for f, d in splits), splits
+
+    pred = bst.predict(df)
+    auc = _auc_of(y, pred)
+    assert auc > 0.95
+
+    # predict through a DataFrame whose category ORDER differs: codes
+    # must be remapped through the training levels, not taken verbatim
+    df2 = df.copy()
+    df2["c"] = pd.Categorical(cat_col, categories=["teal", "blue", "red", "green"])
+    np.testing.assert_allclose(bst.predict(df2), pred, rtol=1e-6)
+
+    # pandas_categorical survives the model string round-trip
+    bst2 = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(bst2.predict(df2), pred, rtol=1e-6)
+
+
+def _auc_of(y, s):
+    from sklearn.metrics import roc_auc_score
+
+    return roc_auc_score(y, s)
+
+
 def test_save_load_predict_roundtrip(regression_data, tmp_path):
     X, y, Xt, yt = regression_data
     params = {"objective": "regression", "verbose": -1}
