@@ -59,6 +59,25 @@ def enable_compile_cache():
     except Exception:  # pragma: no cover — cache is best-effort
         pass
 
+def _honor_jax_platforms_env():
+    """The axon TPU plugin ignores the JAX_PLATFORMS env var (only the
+    config knob wins), so a caller exporting JAX_PLATFORMS=cpu — e.g. the
+    CLI under a dead/absent tunnel — would still block on TPU backend
+    init.  Mirror the env var into the config before first device use."""
+    import os
+
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    if plat:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", plat)
+        except Exception:  # pragma: no cover
+            pass
+
+
+_honor_jax_platforms_env()
+
 from .basic import Booster, Dataset
 from .engine import cv, train
 from .callback import early_stopping, log_evaluation, record_evaluation, reset_parameter
@@ -96,3 +115,10 @@ __all__ = [
     "plot_tree",
     "create_tree_digraph",
 ]
+
+# Re-assert the caller's platform choice AFTER the package imports: pulling
+# in the Pallas kernel modules triggers the axon plugin's registration,
+# which overwrites jax_platforms with "axon,cpu" — under a dead/absent
+# tunnel the next device access would then hang in the axon PJRT client
+# instead of using the requested CPU backend.
+_honor_jax_platforms_env()

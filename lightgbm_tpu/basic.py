@@ -79,6 +79,13 @@ def _map_pandas_categorical(data, pandas_categorical):
                 if isinstance(data.dtypes.iloc[i], pd.CategoricalDtype)]
     if not cat_cols:
         return data
+    if len(cat_cols) != len(pandas_categorical):
+        # the reference raises on exactly this shape mismatch
+        # ("train and valid dataset categorical_feature do not match")
+        Log.fatal(
+            "predict data has %d pandas categorical columns but the model "
+            "was trained with %d", len(cat_cols), len(pandas_categorical),
+        )
     data = data.copy(deep=False)
     for col, levels in zip(cat_cols, pandas_categorical):
         codes = pd.Categorical(data[col], categories=levels).codes.astype(np.float64)

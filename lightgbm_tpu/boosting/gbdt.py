@@ -138,8 +138,7 @@ class GBDT:
                 # eligible (histogram psum per split); feature/voting
                 # keep the mask grower's collective formulations
                 if (learner_type == "data" and self.supports_partitioned
-                        and self.supports_partitioned_data
-                        and self.num_tree_per_iteration == 1):
+                        and self.supports_partitioned_data):
                     from .ptrainer import (
                         ShardedPartitionedTrainer,
                         eligible as _pt_eligible,

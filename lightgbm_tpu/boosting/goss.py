@@ -21,7 +21,9 @@ class GOSS(GBDT):
     # top_k + Bernoulli rest inside the chunk program); the hooks below
     # remain for the mask-grower fallback
     supports_partitioned = True
-    supports_partitioned_data = False  # global top_k not sharded yet
+    # data-parallel GOSS samples per shard, matching the reference's
+    # per-machine local TopK (goss.hpp Bagging over the local partition)
+    supports_partitioned_data = True
 
     def init(self, config, train_set, objective, training_metrics=()):
         super().init(config, train_set, objective, training_metrics)

@@ -557,7 +557,11 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
             bits = int(force_bits)
             if bits == 4 and max_col_bin > 16:
                 bits = 8
-        self.layout = PLayout(matrix.shape[1], num_score=1, with_weight=True, bits=bits)
+        # K > 1: multiclass data-parallel — K score channels, K trees per
+        # iteration from one gradient pass (same as the serial trainer)
+        self.K = int(getattr(objective, "num_tree_per_iteration", 1))
+        self.layout = PLayout(matrix.shape[1], num_score=self.K,
+                              with_weight=True, bits=bits)
 
         from ..ops.pkernels import BLK, pack_matrix
 
@@ -592,8 +596,6 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
         else:
             self.p = _jax.device_put(jnp.asarray(local), sharding)
 
-        self.K = 1  # sharded fast path is single-class (multiclass
-        #             data-parallel keeps the mask grower)
         self.meta = meta
         self.hyper = hyper
         self.objective = objective
@@ -654,47 +656,49 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
         return _jax.device_put(jnp.asarray(local.reshape(-1)), sharding)
 
     def _gather_rows(self, garr):
-        """Global (d * nl,) row-sharded array -> process-local (n,) numpy."""
+        """Global (d * nl,) — or (K, d * nl), rows on the LAST axis —
+        row-sharded array -> process-local (n,) / (K, n) numpy."""
         import jax as _jax
 
+        axis = garr.ndim - 1
         if _jax.process_count() > 1:
             shards = sorted(garr.addressable_shards,
-                            key=lambda s: (s.index[0].start or 0))
-            local = np.concatenate([np.asarray(s.data) for s in shards])
+                            key=lambda s: (s.index[axis].start or 0))
+            local = np.concatenate([np.asarray(s.data) for s in shards],
+                                   axis=axis)
         else:
             local = np.asarray(garr)
         nl = self.num_rows
         parts = []
         for k in range(self.d_local):
             lo, hi = k * nl, min((k + 1) * nl, self.local_rows)
-            parts.append(local[k * nl : k * nl + max(0, hi - lo)])
-        return np.concatenate(parts) if parts else local[:0]
+            parts.append(local[..., k * nl : k * nl + max(0, hi - lo)])
+        return (np.concatenate(parts, axis=axis) if parts
+                else local[..., :0])
 
-    def _apply_delta(self, delta) -> None:
-        """delta in process-row order (n,); applied per shard in place."""
+    def _apply_delta(self, delta, k: int = 0) -> None:
+        """delta in process-row order (n,); applied per shard in place to
+        score channel ``k`` (score-only streamer — gradient channels
+        refresh at the next chunk's update pass, like the serial path)."""
         from jax.sharding import PartitionSpec as P
 
         if self._apply_prog is None:
+            self._apply_prog = {}
+        if k not in self._apply_prog:
             lay = self.layout
             interp = self.interpret
-            params = self.params
             nl = self.num_rows
 
-            def shard_body(pg, dg):
-                p, _ = update_and_root_hist(
-                    pg[0], lay, self._grad_fn, delta=dg, num_rows=nl,
-                    num_features=(params.num_cols or params.num_features),
-                    num_bins=(params.num_bins_hist or params.num_bins),
-                    bits=params.bits, interpret=interp,
-                )
-                return p[None]
+            def shard_body(pg, dg, k=k):
+                return score_add(pg[0], lay, dg, k, num_rows=nl,
+                                 interpret=interp)[None]
 
-            self._apply_prog = jax.jit(
+            self._apply_prog[k] = jax.jit(
                 self._shard_map(shard_body, (P("data"), P("data")), P("data")),
                 donate_argnums=(0,),
             )
         dg = delta if hasattr(delta, "sharding") else self._make_row_global(delta)
-        self.p = self._apply_prog(self.p, dg)
+        self.p = self._apply_prog[k](self.p, dg)
 
     def add_score_constant(self, c: float) -> None:
         # constant only on REAL rows (padding rows' scores are unused)
@@ -709,30 +713,28 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
         from jax.sharding import PartitionSpec as P
 
         if getattr(self, "_sync_prog", None) is None:
-            lay = self.layout
-            interp = self.interpret
-            params = self.params
-            nl = self.num_rows
+            self._sync_prog = {}
+        lay = self.layout
+        interp = self.interpret
+        nl = self.num_rows
+        target = np.atleast_2d(np.asarray(scores_orig, np.float32))
+        for k in range(self.K):
+            if k not in self._sync_prog:
 
-            def shard_body(pg, tg):
-                p = pg[0]
-                rowid = p[lay.ROWID, :nl]
-                cur = _i2f(p[lay.SCORE, :nl])
-                dphys = tg[rowid] - cur
-                p, _ = update_and_root_hist(
-                    p, lay, self._grad_fn, delta=dphys, num_rows=nl,
-                    num_features=(params.num_cols or params.num_features),
-                    num_bins=(params.num_bins_hist or params.num_bins),
-                    bits=params.bits, interpret=interp,
+                def shard_body(pg, tg, k=k):
+                    p = pg[0]
+                    rowid = p[lay.ROWID, :nl]
+                    cur = _i2f(p[lay.SCORE + k, :nl])
+                    dphys = tg[rowid] - cur
+                    return score_add(p, lay, dphys, k, num_rows=nl,
+                                     interpret=interp)[None]
+
+                self._sync_prog[k] = jax.jit(
+                    self._shard_map(shard_body, (P("data"), P("data")), P("data")),
+                    donate_argnums=(0,),
                 )
-                return p[None]
-
-            self._sync_prog = jax.jit(
-                self._shard_map(shard_body, (P("data"), P("data")), P("data")),
-                donate_argnums=(0,),
-            )
-        tg = self._make_row_global(np.asarray(scores_orig, np.float32))
-        self.p = self._sync_prog(self.p, tg)
+            tg = self._make_row_global(target[k])
+            self.p = self._sync_prog[k](self.p, tg)
         self.score_dirty = False
 
     def _scores_global(self):
@@ -741,23 +743,33 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
         if self._scores_prog is None:
             lay = self.layout
             nl = self.num_rows
+            K = self.K
 
             def shard_body(pg):
                 p = pg[0]
                 rowid = p[lay.ROWID, :nl]
-                sc = _i2f(p[lay.SCORE, :nl])
-                return jnp.zeros((nl,), jnp.float32).at[rowid].set(sc)
+                outs = [
+                    jnp.zeros((nl,), jnp.float32).at[rowid].set(
+                        _i2f(p[lay.SCORE + k, :nl])
+                    )
+                    for k in range(K)
+                ]
+                return jnp.stack(outs)  # (K, nl)
 
             self._scores_prog = jax.jit(
-                self._shard_map(shard_body, (P("data"),), P("data"))
+                self._shard_map(shard_body, (P("data"),), P(None, "data"))
             )
-        return self._scores_prog(self.p)
+        return self._scores_prog(self.p)  # (K, d * nl)
 
     def scores_original_order(self):
-        return jnp.asarray(self._gather_rows(self._scores_global()))
+        """(N,) for K == 1, else (K, N)."""
+        got = jnp.asarray(self._gather_rows(self._scores_global()))
+        return got[0] if self.K == 1 else got
 
     def rollback_last(self) -> bool:
-        if self._last_tree is None:
+        """K > 1 chunks track only the last class's delta; they resync
+        via score_dirty instead (same contract as the serial trainer)."""
+        if self._last_tree is None or self.K != 1:
             return False
         import jax as _jax
 
@@ -774,7 +786,9 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
         nl = self.num_rows
         L = self.params.num_leaves
         F = self.params.num_features
+        K = self.K
         grad_fn = self._grad_fn
+        grad_all_fn = self._grad_all_fn
         params = self.params
         meta = self.meta
         hyper = self.hyper
@@ -783,6 +797,17 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
         bag_frac = float(self.config.bagging_fraction)
         G = params.num_cols or F
         BH = params.num_bins_hist or params.num_bins
+        cfg = self.config
+        # GOSS in data-parallel mode is LOCAL per shard — the reference's
+        # distributed GOSS also samples per machine over local indices
+        # (goss.hpp Bagging over the local data partition); counts scale
+        # with each shard's real rows
+        goss_on = (getattr(cfg, "boosting", "gbdt") == "goss") and K == 1
+        if goss_on:
+            top_rate = float(cfg.top_rate)
+            other_rate = float(cfg.other_rate)
+            top_cnt_max = max(1, int(np.ceil(top_rate * nl)))
+            goss_warm = int(1.0 / float(cfg.learning_rate))
 
         def shard_body(pg, nreal_g, lr, key, iter0, t_run):
             p = pg[0]
@@ -799,6 +824,10 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
             def _live_iter(t, carry):
                 (p, recs, stopped, delta, last_kept) = carry
                 it = iter0 + t
+                # validity must travel WITH the row: split_stream permutes
+                # shard columns, so padding is identified by the preserved
+                # ROWID channel (local rowid >= nreal), never by position
+                valid = (p[lay.ROWID, :nl] < nreal).astype(jnp.float32)
                 if bag_on:
                     bkey = jax.random.fold_in(
                         jax.random.fold_in(
@@ -806,21 +835,9 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
                         ), ax
                     )
                     sel = jax.random.bernoulli(bkey, bag_frac, (nl,)).astype(jnp.float32)
-                    # validity must travel WITH the row: split_stream
-                    # permutes shard columns, so padding is identified by
-                    # the preserved ROWID channel (local rowid >= nreal),
-                    # never by position
-                    valid = (p[lay.ROWID, :nl] < nreal).astype(jnp.float32)
                     sel = sel * valid
                 else:
                     sel = None
-                p, root_hist = update_and_root_hist(
-                    p, lay, grad_fn, delta=delta, sel=sel, num_rows=nl,
-                    num_features=G, num_bins=BH, bits=params.bits,
-                    interpret=interpret,
-                )
-                root_hist = jax.lax.psum(root_hist, "data")
-
                 if used_features < F:
                     fkey = jax.random.fold_in(jax.random.fold_in(key, 1), it)
                     u = jax.random.uniform(fkey, (F,))
@@ -829,46 +846,128 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
                 else:
                     fmask = jnp.ones((F,), jnp.float32)
 
-                tree, p = grow_tree_partitioned(
-                    p, fmask, meta, hyper, params, bmeta=bmeta,
-                    interpret=interpret, root_hist=root_hist,
-                )
+                ns_t = recs["num_splits"][t]
+                raw_t = recs["raw"][t]
+                if K == 1:
+                    if goss_on:
+                        # settle pending delta + fresh gradients first,
+                        # then local top-k + Bernoulli rest-sample
+                        # (goss.hpp:126-198 over the shard's rows)
+                        p, _ = update_and_root_hist(
+                            p, lay, grad_fn, delta=delta, num_rows=nl,
+                            num_features=G, num_bins=BH, bits=params.bits,
+                            interpret=interpret,
+                        )
+                        gv = _i2f(p[lay.G, :nl])
+                        hv = _i2f(p[lay.H, :nl])
+                        gscore = jnp.abs(gv * hv) * valid
+                        top_c = jnp.maximum(jnp.floor(top_rate * nreal), 1.0)
+                        other_c = jnp.maximum(jnp.floor(other_rate * nreal), 1.0)
+                        goss_mult = (nreal - top_c) / other_c
+                        goss_prob = other_c / jnp.maximum(nreal - top_c, 1.0)
+                        top_vals, _ = jax.lax.top_k(gscore, top_cnt_max)
+                        kth = jnp.clip(top_c.astype(jnp.int32), 1, top_cnt_max) - 1
+                        thr_v = top_vals[kth]
+                        is_top = (gscore >= thr_v) & (gscore > 0)
+                        gkey = jax.random.fold_in(
+                            jax.random.fold_in(jax.random.fold_in(key, 2), it), ax
+                        )
+                        sampled = ((~is_top)
+                                   & (jax.random.uniform(gkey, (nl,)) < goss_prob)
+                                   & (valid > 0))
+                        warm = it < goss_warm
+                        selv = jnp.where(
+                            warm, valid, (is_top | sampled).astype(jnp.float32)
+                        )
+                        mulv = jnp.where(warm | (~sampled), 1.0, goss_mult)
+                        p, root_hist = update_and_root_hist(
+                            p, lay, grad_fn, sel=selv, mul=mulv,
+                            num_rows=nl, num_features=G, num_bins=BH,
+                            bits=params.bits, interpret=interpret,
+                        )
+                        delta = jnp.zeros((nl,), jnp.float32)
+                    else:
+                        p, root_hist = update_and_root_hist(
+                            p, lay, grad_fn, delta=delta, sel=sel, num_rows=nl,
+                            num_features=G, num_bins=BH, bits=params.bits,
+                            interpret=interpret,
+                        )
+                    root_hist = jax.lax.psum(root_hist, "data")
+                    tree, p = grow_tree_partitioned(
+                        p, fmask, meta, hyper, params, bmeta=bmeta,
+                        interpret=interpret, root_hist=root_hist,
+                    )
+                    keep = ((tree.num_splits > 0) & (~stopped)).astype(jnp.float32)
+                    lval = jnp.clip(lr * tree.leaf_value, -100.0, 100.0)
+                    delta = segment_values(tree, nl, keep * lval)
+                    last_kept = jnp.where(keep > 0, delta, last_kept)
+                    any_split = tree.num_splits > 0
+                    ns_t = ns_t.at[0].set(tree.num_splits)
+                    raw_t = raw_t.at[0].set(tree.recs_raw)
+                else:
+                    # K trees per iteration from one gradient pass; each
+                    # class's delta lands on its score row immediately
+                    # after its tree (mirrors the serial K > 1 branch,
+                    # with per-level hist psums inside the grower)
+                    p, hists = update_multi_and_hists(
+                        p, lay, grad_all_fn, sel=sel, num_rows=nl,
+                        num_features=G, num_bins=BH, bits=params.bits,
+                        interpret=interpret,
+                    )
+                    hists = jax.lax.psum(hists, "data")
+                    any_split = jnp.array(False)
+                    for k in range(K):
+                        tree, p = grow_tree_partitioned(
+                            p, fmask, meta, hyper, params, bmeta=bmeta,
+                            interpret=interpret, root_hist=hists[k],
+                            rows=lay.class_rows(k),
+                        )
+                        keep = ((tree.num_splits > 0) & (~stopped)).astype(jnp.float32)
+                        lval = jnp.clip(lr * tree.leaf_value, -100.0, 100.0)
+                        dk = segment_values(tree, nl, keep * lval)
+                        p = score_add(p, lay, dk, k, num_rows=nl,
+                                      interpret=interpret)
+                        any_split = any_split | (tree.num_splits > 0)
+                        ns_t = ns_t.at[k].set(tree.num_splits)
+                        raw_t = raw_t.at[k].set(tree.recs_raw)
 
-                keep = ((tree.num_splits > 0) & (~stopped)).astype(jnp.float32)
-                lval = jnp.clip(lr * tree.leaf_value, -100.0, 100.0)
-                delta_next = segment_values(tree, nl, keep * lval)
-                last_kept = jnp.where(keep > 0, delta_next, last_kept)
                 recs = {
-                    "num_splits": recs["num_splits"].at[t, 0].set(tree.num_splits),
-                    "raw": recs["raw"].at[t, 0].set(tree.recs_raw),
+                    "num_splits": recs["num_splits"].at[t].set(ns_t),
+                    "raw": recs["raw"].at[t].set(raw_t),
                 }
-                new_stopped = stopped | (tree.num_splits == 0)
-                return (p, recs, new_stopped, delta_next, last_kept)
+                new_stopped = stopped | (~any_split)
+                return (p, recs, new_stopped, delta, last_kept)
 
             m = L - 1
             recs0 = {
-                "num_splits": jnp.zeros((T, 1), jnp.int32),
-                "raw": jnp.zeros((T, 1, m, 12)),
+                "num_splits": jnp.zeros((T, K), jnp.int32),
+                "raw": jnp.zeros((T, K, m, 12)),
             }
             carry0 = (p, recs0, jnp.array(False), jnp.zeros((nl,), jnp.float32),
                       jnp.zeros((nl,), jnp.float32))
             p, recs, _, last_delta, last_kept = jax.lax.fori_loop(
                 0, jnp.minimum(t_run, T), one_iter, carry0
             )
-            p, _ = update_and_root_hist(
-                p, lay, grad_fn, delta=last_delta, num_rows=nl,
-                num_features=G, num_bins=BH, bits=params.bits,
-                interpret=interpret,
-            )
+            if K == 1:
+                p, _ = update_and_root_hist(
+                    p, lay, grad_fn, delta=last_delta, num_rows=nl,
+                    num_features=G, num_bins=BH, bits=params.bits,
+                    interpret=interpret,
+                )
             rowid = p[lay.ROWID, :nl]
-            sc = _i2f(p[lay.SCORE, :nl])
-            scores_local = jnp.zeros((nl,), jnp.float32).at[rowid].set(sc)
+            scores_local = jnp.stack([
+                jnp.zeros((nl,), jnp.float32).at[rowid].set(
+                    _i2f(p[lay.SCORE + k, :nl])
+                )
+                for k in range(K)
+            ])  # (K, nl)
             return p[None], recs, scores_local, last_kept
 
         mapped = self._shard_map(
             shard_body,
             (P("data"), P("data"), P(), P(), P(), P()),
-            (P("data"), {"num_splits": P(), "raw": P()}, P("data"), P("data")),
+            (P("data"), {"num_splits": P(), "raw": P()}, P(None, "data"),
+             P("data")),
         )
         return jax.jit(mapped, donate_argnums=(0,))
 
@@ -918,11 +1017,12 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
                 jnp.int32(iter0 + n_done), jnp.int32(step),
             )
             part = jax.device_get(recs)
-            ns = part["num_splits"][:step]  # (step, 1)
+            ns = part["num_splits"][:step]  # (step, K)
             stop = np.nonzero(np.all(ns == 0, axis=1))[0]
             done_here = int(stop[0]) if stop.size else step
             if done_here > 0:
-                self._last_tree = last_kept
+                # K > 1 resyncs via score_dirty on rollback instead
+                self._last_tree = last_kept if self.K == 1 else None
             part = {k: v[:done_here] for k, v in part.items()}
             recs_np = part if recs_np is None else {
                 k: np.concatenate([recs_np[k], part[k]]) for k in part
@@ -931,7 +1031,8 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
             remaining -= step
             if done_here < step:
                 break
-        scores_orig = jnp.asarray(self._gather_rows(scores))
+        got = jnp.asarray(self._gather_rows(scores))
+        scores_orig = got[0] if self.K == 1 else got
         return recs_np, scores_orig, n_done
 
 
@@ -956,6 +1057,12 @@ def eligible(config, train_set, objective, num_tree_per_iteration: int) -> bool:
             return False
         if num_tree_per_iteration > 16:
             return False
+        # multiclass GOSS: the fused trainers' GOSS sampling is K == 1
+        # only — fall back to the mask grower, whose _adjust_gradients
+        # hooks apply real GOSS to every class (silently training plain
+        # GBDT here would be an algorithm regression)
+        if getattr(config, "boosting", "gbdt") == "goss":
+            return False
     # serial -> PartitionedTrainer; data -> ShardedPartitionedTrainer
     # (feature/voting keep the mask grower's collective formulations)
     if config.tree_learner not in ("serial", "data"):
@@ -967,9 +1074,18 @@ def eligible(config, train_set, objective, num_tree_per_iteration: int) -> bool:
     # bundling is built lazily, only once a partitioned run is plausible
     if hasattr(train_set, "ensure_bundles"):
         train_set.ensure_bundles(config)
-    # the histogram kernel unrolls per-column one-hot builds; very wide
-    # unbundled matrices blow up the Mosaic program (EFB normally keeps
-    # G small — beyond this, the mask-based grower handles it)
+    # Wide-matrix ceiling (Bosch-968/Epsilon-2000 shapes): two hard
+    # budgets bound the fused kernels, not just the per-column unroll.
+    # (a) Mosaic program size grows linearly with the per-block one-hot
+    #     unroll (fixable with a rolled word-group loop), and
+    # (b) VMEM: the split/level kernels hold 11 (C, BLK) stream buffers
+    #     + the (BLK, BLK) tri + the (16, G*B) hist accumulators; at
+    #     G=968, B=64 that is ~17 MB at BLK=1024 and the level kernel's
+    #     double-buffered hist alone is ~8 MB — G=2000 cannot fit any
+    #     BLK without spilling accumulators to HBM.
+    # Beyond the cap the mask-based grower (which tiles columns freely
+    # at the XLA level) handles these shapes; gpu_tree_learner.cpp's
+    # multi-tuple packing is the reference analogue of that fallback.
     bundle = getattr(train_set, "bundle", None)
     cols = bundle.num_cols if bundle is not None else train_set.num_features
     if cols > 512:

@@ -129,9 +129,10 @@ def train(
     # evaluates at output_freq granularity the same way,
     # application.cpp:225-250; the python API's per-iteration eval is
     # preserved whenever period == 1.)
+    # opt-in is output_freq ONLY: an integer verbose_eval controls PRINT
+    # frequency in the reference API, never evaluation frequency, so it
+    # must not change which iterations get evaluated
     period = int(canon.get("output_freq", 1))
-    if isinstance(verbose_eval, int) and verbose_eval is not True and verbose_eval > 1:
-        period = max(period, int(verbose_eval))
     if (
         ptrainer is not None
         and fobj is None

@@ -14,6 +14,16 @@ import jax  # noqa: E402
 # The axon TPU plugin ignores JAX_PLATFORMS; the config knob still wins.
 jax.config.update("jax_platforms", "cpu")
 
+# Importing the package pulls in Pallas, which triggers the axon plugin's
+# registration; that registration OVERWRITES jax_platforms with
+# "axon,cpu" (and would make the first jax.devices() in a test module
+# initialize the axon client — hanging forever when the tunnel is dead).
+# Import it now, re-assert cpu, and pin the backend cache.
+import lightgbm_tpu  # noqa: E402,F401
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu"
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -93,7 +93,9 @@ def test_binary_example_quality(binary_example_data):
     prob = bst.predict(Xt)
     logloss = -np.mean(yt * np.log(np.maximum(prob, 1e-15))
                        + (1 - yt) * np.log(np.maximum(1 - prob, 1e-15)))
-    assert logloss < 0.53
+    # sklearn HistGradientBoosting reaches ~0.512 at these params; a
+    # quality bug > ~1.5% now fails instead of hiding under a loose band
+    assert logloss < 0.52
 
 
 def test_binary_auc(binary_example_data):
@@ -163,7 +165,7 @@ def test_lambdarank():
               valid_sets=[lgb.Dataset(Xt, label=yt, group=gt, reference=ds)],
               evals_result=evals_result, verbose_eval=False)
     ndcg1 = evals_result["valid_0"]["ndcg@1"][-1]
-    assert ndcg1 > 0.55  # reference sklearn test asserts > 0.5644
+    assert ndcg1 > 0.56  # reference sklearn test asserts > 0.5644
 
 
 def test_early_stopping(binary_data):
