@@ -77,7 +77,6 @@ from ..ops.pkernels import (
     pack_matrix_device,
     score_add,
     update_and_root_hist,
-    update_channels,
     update_multi_and_hists,
 )
 from ..ops.split import FeatureMeta, SplitHyper

@@ -119,6 +119,68 @@ class TestSplitStreamKernel:
                                 rng.randrange(0, 31), 0, 0, 0)
 
 
+class TestLevelStreamKernel:
+    """level_stream (one launch, many segments) must reproduce
+    split_stream segment-for-segment: same left counts, same children
+    histograms, and the identical in-place partition — including empty,
+    tiny-unaligned, and block-aligned segments in one call."""
+
+    def test_matches_split_stream_per_segment(self):
+        P, lay, *_ = _make_packed(n=6000)
+        F, B = lay.F, 32
+        per = 32 // lay.bits
+        # disjoint segments covering assorted shapes (cnt=0 is a leaf the
+        # level pass must pass through untouched)
+        segs = [
+            (0, 1024, 3, 15, 0, 0, 0),
+            (1024, 0, 0, 7, 0, 0, 0),       # empty, block-aligned start
+            (1024, 137, 0, 7, 5, 11, 0),    # tiny + zero-bin remap
+            (1161, 2935, 10, 4, 0, 0, 1),   # categorical
+            (4096, 1904, 7, 20, 0, 0, 0),
+        ]
+        smax = 8
+        tab = np.zeros((smax, 12), np.int32)
+        for i, (s, c, f, t, zb, dbz, cat) in enumerate(segs):
+            tab[i] = [s, c, f // per, (f % per) * lay.bits, zb, dbz, t, cat,
+                      0, 1 << lay.bits, 0, 0]
+        pl_, nl, hists = pk.level_stream(
+            P, jnp.asarray(tab), jnp.int32(len(segs)), num_features=F,
+            num_bins=B, bits=lay.bits, rows=lay.rows, smax=smax,
+            interpret=INTERP,
+        )
+        pl_ = np.asarray(pl_)
+        nl = np.asarray(nl)
+        hists = np.asarray(hists)
+
+        ps = P
+        for i, (s, c, f, t, zb, dbz, cat) in enumerate(segs):
+            ps, nls, lh, rh = pk.split_stream(
+                ps, s, c, f // per, (f % per) * lay.bits, zb, dbz, t, cat,
+                num_features=F, num_bins=B, bits=lay.bits, rows=lay.rows,
+                interpret=INTERP,
+            )
+            assert int(nls) == int(nl[i]), f"seg {i} left count"
+            from lightgbm_tpu.ops.pkernels import _hist_from_rows
+
+            ll = np.asarray(_hist_from_rows(jnp.asarray(hists[i]), F, B, row0=0))
+            rr = np.asarray(_hist_from_rows(jnp.asarray(hists[i]), F, B, row0=7))
+            tol = 2e-3 if INTERP else 1e-5
+            for got, want in ((ll, np.asarray(lh)), (rr, np.asarray(rh))):
+                err = np.abs(got - want).max() / max(np.abs(want).max(), 1.0)
+                assert err < tol, f"seg {i} hist mismatch {err}"
+        # identical in-place partition (same protocol, same block order)
+        np.testing.assert_array_equal(pl_, np.asarray(ps))
+
+    def test_zero_active_is_noop(self):
+        P, lay, *_ = _make_packed(n=3000)
+        tab = jnp.zeros((8, 12), jnp.int32)
+        pl_, nl, _ = pk.level_stream(
+            P, tab, jnp.int32(0), num_features=lay.F, num_bins=32,
+            bits=lay.bits, rows=lay.rows, smax=8, interpret=INTERP,
+        )
+        np.testing.assert_array_equal(np.asarray(pl_), np.asarray(P))
+
+
 class TestTwoEndProtocol:
     """Host-side block-level simulation of split_stream's two-ended
     read/write protocol (demand reads, force-consume, hand-side prefetch,
