@@ -72,6 +72,26 @@ def main():
             and os.environ.get("BENCH_NO_GUARD", "0") != "1"):
         import subprocess
 
+        # fail FAST when the accelerator is unreachable: a dead axon
+        # tunnel makes backend init hang far past any useful timeout
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import os, jax;"
+                 "p = os.environ.get('JAX_PLATFORMS', '');"
+                 "p and jax.config.update('jax_platforms', p);"
+                 "print(jax.default_backend())"],
+                timeout=180, capture_output=True, text=True,
+            )
+            if probe.returncode != 0 or not (probe.stdout or "").strip():
+                print("# device backend probe failed:\n"
+                      + (probe.stderr or "")[-800:], file=sys.stderr)
+                sys.exit(1)
+        except subprocess.TimeoutExpired:
+            print("# device backend init timed out (dead tunnel?) — "
+                  "no benchmark possible", file=sys.stderr)
+            sys.exit(1)
+
         # budget scales with the configured row count (Higgs-scale runs
         # legitimately take much longer than the 1M default)
         rows = int(os.environ.get("BENCH_ROWS", 1_000_000))

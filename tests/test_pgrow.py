@@ -160,10 +160,8 @@ class TestLevelStreamKernel:
                 interpret=INTERP,
             )
             assert int(nls) == int(nl[i]), f"seg {i} left count"
-            from lightgbm_tpu.ops.pkernels import _hist_from_rows
-
-            ll = np.asarray(_hist_from_rows(jnp.asarray(hists[i]), F, B, row0=0))
-            rr = np.asarray(_hist_from_rows(jnp.asarray(hists[i]), F, B, row0=7))
+            ll = np.asarray(pk._hist_from_rows(jnp.asarray(hists[i]), F, B, row0=0))
+            rr = np.asarray(pk._hist_from_rows(jnp.asarray(hists[i]), F, B, row0=7))
             tol = 2e-3 if INTERP else 1e-5
             for got, want in ((ll, np.asarray(lh)), (rr, np.asarray(rh))):
                 err = np.abs(got - want).max() / max(np.abs(want).max(), 1.0)
