@@ -48,6 +48,25 @@ def make_higgs_shaped(n_rows: int, n_features: int = 28, seed: int = 7):
     return X, y
 
 
+def _report_partial_trace(trace_path, mode):
+    """A dead/failed bench run still explains itself: summarize whatever
+    per-iteration / per-phase records the child flushed before dying."""
+    import sys
+
+    if not os.path.exists(trace_path):
+        return
+    try:
+        from lightgbm_tpu.obs import report
+
+        summary = report.summarize(report.load_trace(trace_path))
+        print(f"# levelgrow={mode} partial trace ({trace_path}):",
+              file=sys.stderr)
+        print("# " + json.dumps(summary), file=sys.stderr)
+    except Exception as e:  # pragma: no cover - best-effort forensics
+        print(f"# trace summary failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
+
 def _auc(y, s):
     """AUC via the library's own metric (one implementation to trust)."""
     from lightgbm_tpu.config import Config
@@ -101,6 +120,13 @@ def main():
         ))
         for mode in ("1", "0"):
             env = dict(os.environ, LIGHTGBM_TPU_LEVELGROW=mode)
+            # run trace: always on for the child (obs/trace.py JSONL) so a
+            # FAILED bench still leaves the per-phase records it gathered
+            # before death; the path survives the subprocess boundary
+            trace_path = env.get("LIGHTGBM_TPU_TRACE") or os.path.abspath(
+                f"bench_trace.levelgrow{mode}.jsonl"
+            )
+            env["LIGHTGBM_TPU_TRACE"] = trace_path
             try:
                 r = subprocess.run(
                     [sys.executable, os.path.abspath(__file__)],
@@ -109,6 +135,7 @@ def main():
             except subprocess.TimeoutExpired:
                 print(f"# levelgrow={mode} bench timed out after {budget}s",
                       file=sys.stderr)
+                _report_partial_trace(trace_path, mode)
                 continue
             if r.returncode == 0 and '"metric"' in r.stdout:
                 line = [ln for ln in r.stdout.splitlines() if '"metric"' in ln][-1]
@@ -120,6 +147,7 @@ def main():
                 return
             print(f"# levelgrow={mode} bench failed rc={r.returncode}:\n"
                   + (r.stderr or "")[-2000:], file=sys.stderr)
+            _report_partial_trace(trace_path, mode)
         sys.exit(1)
 
     n_rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
@@ -183,6 +211,29 @@ def main():
         run_iters(per)
         windows.append((time.time() - t0) / per)
     sec_per_iter = float(np.median(windows))
+
+    # ---- phase attribution pass (after the timed windows, so the
+    # defused traced mode cannot pollute the s/iter number): a few extra
+    # iterations with per-phase fencing explain where the time goes ----
+    from lightgbm_tpu.obs import compilewatch, tracer
+
+    attrib_iters = int(os.environ.get("BENCH_ATTRIB_ITERS", 2))
+    if tracer.enabled and fused and attrib_iters > 0 and getattr(
+        gb.ptrainer, "supports_traced", False
+    ) and gb.num_tree_per_iteration == 1:
+        phases_before = os.environ.get("LIGHTGBM_TPU_TRACE_PHASES")
+        os.environ["LIGHTGBM_TPU_TRACE_PHASES"] = "1"
+        tracer._phases_env = "1"
+        try:
+            gb.train_iters_partitioned(attrib_iters, is_eval=False)
+            total_iters += attrib_iters
+        finally:
+            if phases_before is None:
+                os.environ.pop("LIGHTGBM_TPU_TRACE_PHASES", None)
+                tracer._phases_env = ""
+            else:
+                os.environ["LIGHTGBM_TPU_TRACE_PHASES"] = phases_before
+                tracer._phases_env = phases_before
 
     # ---- quality signal on held-out rows of the SAME task ----
     prob = booster.predict(Xt)
@@ -276,6 +327,22 @@ def main():
         out["valid_run_total_s"] = round(eval_total, 2)
         out["evalfree_run_total_s"] = round(ref_total, 2)
         out["valid_overhead_ratio"] = round(eval_total / max(ref_total, 1e-9), 3)
+
+    # run-trace embedding (docs/OBSERVABILITY.md): the per-phase span
+    # totals and compile accounting gathered during THIS run, so the
+    # BENCH_*.json line finally explains its own s/iter number
+    if tracer.enabled:
+        snap = tracer.snapshot()
+        out["trace_path"] = tracer.path
+        out["phase_breakdown"] = snap["spans"]
+        cw = compilewatch.snapshot()
+        out["compile_stats"] = {
+            "backend_compiles": cw["backend_compiles"],
+            "backend_compile_secs": cw["backend_compile_secs"],
+            "retraces_flagged": sum(
+                w["retraces"] for w in cw["watched"].values()
+            ),
+        }
 
     # device memory footprint (validates the no-scratch-copy design at
     # Higgs scale; axon may not expose memory_stats — best-effort)

@@ -210,6 +210,8 @@ class Dataset:
             cats = list(self._auto_categorical)
 
         ref = self.reference.construct() if self.reference is not None else None
+        if self.reference is not None:
+            self._remap_categorical_to_reference(self.reference)
         self._constructed = BinnedDataset.from_raw(
             self.data,
             cfg,
@@ -227,6 +229,41 @@ class Dataset:
         return self._constructed
 
     # ------------------------------------------------------------------
+    def _remap_categorical_to_reference(self, ref: "Dataset") -> None:
+        """Validation Dataset built from a pandas frame: its category
+        columns were coded against the frame's OWN level order
+        (_to_2d_float), but the tree thresholds are bin ids over the
+        TRAINING set's levels — remap codes through the reference's
+        ``pandas_categorical`` (the reference's _data_from_pandas
+        round-trip) and, like the reference, raise when the categorical
+        column sets don't line up."""
+        train_levels = getattr(ref, "pandas_categorical", None) or []
+        my_levels = getattr(self, "pandas_categorical", None) or []
+        if not my_levels and not train_levels:
+            return
+        if len(my_levels) != len(train_levels):
+            Log.fatal(
+                "train and valid dataset categorical_feature do not match: "
+                "valid has %d pandas categorical columns, train has %d",
+                len(my_levels), len(train_levels),
+            )
+        if self.data is None:
+            return
+        for col_idx, vl, tl in zip(self._auto_categorical, my_levels,
+                                   train_levels):
+            if list(vl) == list(tl):
+                continue
+            # valid-code -> train-code lookup; levels unseen at train
+            # time become missing (NaN), matching predict-time remap
+            pos = {v: i for i, v in enumerate(tl)}
+            lut = np.asarray([pos.get(v, np.nan) for v in vl], np.float64)
+            col = np.asarray(self.data[:, col_idx], np.float64)
+            ok = ~np.isnan(col)
+            out = np.full(col.shape, np.nan)
+            out[ok] = lut[col[ok].astype(np.int64)]
+            self.data[:, col_idx] = out
+        self.pandas_categorical = [list(t) for t in train_levels]
+
     def create_valid(self, data, label=None, weight=None, group=None,
                      init_score=None, silent=False, params=None) -> "Dataset":
         return Dataset(
@@ -377,18 +414,21 @@ class Booster:
     # ------------------------------------------------------------------
     def _strip_pandas_categorical(self, model_str: str) -> str:
         """Parse + remove the trailing pandas_categorical json line
-        (written by model_to_string; reference model-file convention)."""
+        (written by model_to_string; reference model-file convention).
+        The removal span comes from the RAW line — computing it from the
+        stripped text mis-sliced model files with CRLF endings or
+        trailing whitespace on the line."""
         marker = "\npandas_categorical:"
         pos = model_str.rfind(marker)
         if pos >= 0:
             import json
 
-            line = model_str[pos + len(marker):].splitlines()[0].strip()
+            raw_line, _, rest = model_str[pos + len(marker):].partition("\n")
             try:
-                self.pandas_categorical = json.loads(line) or []
+                self.pandas_categorical = json.loads(raw_line.strip()) or []
             except ValueError:
                 self.pandas_categorical = []
-            model_str = model_str[:pos] + model_str[pos + len(marker) + len(line) + 1:]
+            model_str = model_str[:pos] + rest
         return model_str
 
     def _objective_from_model_string(self, obj_str: str):

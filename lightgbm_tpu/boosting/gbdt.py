@@ -38,6 +38,7 @@ class _memo:
             self.value = self.fn()
         return self.value
 
+from ..obs import fence, tracer
 from ..ops.grow import GrowParams, grow_tree
 from ..ops.predict import add_leaf_outputs, predict_binned, predict_raw
 from ..ops.split import FeatureMeta, SplitHyper
@@ -71,6 +72,7 @@ class GBDT:
     # ------------------------------------------------------------------
     def init(self, config, train_set, objective, training_metrics=()):
         """GBDT::Init + ResetTrainingData (gbdt.cpp:65-218)."""
+        tracer.refresh_from_env()  # LIGHTGBM_TPU_TRACE may be set per-run
         self.config = config
         self.train_set = train_set
         self.objective = objective
@@ -329,57 +331,70 @@ class GBDT:
 
         self._boost_from_average()
 
-        with timetag.phase("boosting"):
-            if gradients is None or hessians is None:
-                grad, hess = self._get_gradients()
-            else:
-                grad = jnp.asarray(np.asarray(gradients, np.float32).reshape(
-                    self.num_tree_per_iteration, -1))
-                hess = jnp.asarray(np.asarray(hessians, np.float32).reshape(
-                    self.num_tree_per_iteration, -1))
-
-        with timetag.phase("bagging"):
-            grad, hess = self._adjust_gradients(grad, hess)
-            self._bagging(self.iter)
-
-        should_continue = False
-        for k in range(self.num_tree_per_iteration):
-            feature_mask = self._feature_mask()
-            with timetag.phase("tree"):
-                if self.learner is not None:
-                    gr = self.learner.grow(
-                        self.bins, grad[k], hess[k], self.select, feature_mask,
-                        self.meta, self.hyper,
-                    )
+        with tracer.iteration(self.iter) as irec:
+            with timetag.phase("boosting"):
+                if gradients is None or hessians is None:
+                    grad, hess = self._get_gradients()
                 else:
-                    gr = grow_tree(
-                        self.bins,
-                        grad[k],
-                        hess[k],
-                        self.select,
-                        feature_mask,
-                        self.meta,
-                        self.hyper,
-                        self.grow_params,
-                    )
-            num_splits = int(gr.num_splits)
-            if num_splits > 0:
-                should_continue = True
-                tree = Tree.from_grow_result(gr, self.train_set)
-                tree.shrinkage(self.shrinkage_rate)
-                with timetag.phase("train_score"):
-                    # score update via the grower's partition (one gather)
-                    lv = np.zeros(self.grow_params.num_leaves, np.float32)
-                    lv[: tree.num_leaves] = tree.leaf_value[: tree.num_leaves]
-                    leaf_vals = jnp.asarray(lv)
-                    self.scores = self.scores.at[k].set(
-                        add_leaf_outputs(self.scores[k], gr.leaf_id, leaf_vals)
-                    )
-                with timetag.phase("valid_score"):
-                    self._add_tree_to_valid_scores(tree, k)
-            else:
-                tree = Tree(2)  # empty tree, kept for alignment
-            self.models.append(tree)
+                    grad = jnp.asarray(np.asarray(gradients, np.float32).reshape(
+                        self.num_tree_per_iteration, -1))
+                    hess = jnp.asarray(np.asarray(hessians, np.float32).reshape(
+                        self.num_tree_per_iteration, -1))
+                fence((grad, hess))
+
+            with timetag.phase("bagging"):
+                grad, hess = self._adjust_gradients(grad, hess)
+                self._bagging(self.iter)
+                fence(self.select)
+
+            should_continue = False
+            leaves_grown = 0
+            for k in range(self.num_tree_per_iteration):
+                feature_mask = self._feature_mask()
+                with timetag.phase("tree"):
+                    if self.learner is not None:
+                        gr = self.learner.grow(
+                            self.bins, grad[k], hess[k], self.select, feature_mask,
+                            self.meta, self.hyper,
+                        )
+                    else:
+                        gr = grow_tree(
+                            self.bins,
+                            grad[k],
+                            hess[k],
+                            self.select,
+                            feature_mask,
+                            self.meta,
+                            self.hyper,
+                            self.grow_params,
+                        )
+                    fence(gr)
+                num_splits = int(gr.num_splits)
+                if num_splits > 0:
+                    should_continue = True
+                    leaves_grown += num_splits + 1
+                    tree = Tree.from_grow_result(gr, self.train_set)
+                    tree.shrinkage(self.shrinkage_rate)
+                    with timetag.phase("train_score"):
+                        # score update via the grower's partition (one gather)
+                        lv = np.zeros(self.grow_params.num_leaves, np.float32)
+                        lv[: tree.num_leaves] = tree.leaf_value[: tree.num_leaves]
+                        leaf_vals = jnp.asarray(lv)
+                        self.scores = self.scores.at[k].set(
+                            add_leaf_outputs(self.scores[k], gr.leaf_id, leaf_vals)
+                        )
+                        fence(self.scores)
+                    with timetag.phase("valid_score"):
+                        self._add_tree_to_valid_scores(tree, k)
+                        fence(self.valid_scores)
+                else:
+                    tree = Tree(2)  # empty tree, kept for alignment
+                self.models.append(tree)
+            if irec is not None:
+                irec["leaves"] = leaves_grown
+                irec["trees"] = self.num_tree_per_iteration
+                if self.is_bagging:
+                    irec["bagged_rows"] = int(jnp.sum(self.select))
 
         if not should_continue:
             Log.warning(
@@ -411,12 +426,45 @@ class GBDT:
         K = self.num_tree_per_iteration
         if pt.score_dirty:
             pt.sync_scores_from(self.scores if K > 1 else self.scores[0])
+        # traced mode: one iteration per dispatch group with REAL per-phase
+        # (histogram/split/partition/score_update) device-synced timings;
+        # opt-in via LIGHTGBM_TPU_TRACE_PHASES (defaults on only in
+        # interpret mode, where defusing doesn't distort the measurement)
+        use_traced = (
+            tracer.enabled
+            and getattr(pt, "supports_traced", False)
+            and K == 1
+            and getattr(self.config, "boosting", "gbdt") != "goss"
+            and tracer.phases_enabled(default=pt.interpret)
+        )
+        import time as _time
+
+        t_chunk0 = _time.perf_counter()
         with timetag.phase("tree"):
-            recs, scores_orig, n_done = pt.train_chunk(
-                num_iters, self.shrinkage_rate, self.iter
-            )
+            if use_traced:
+                recs, scores_orig, n_done = pt.train_chunk_traced(
+                    num_iters, self.shrinkage_rate, self.iter
+                )
+            else:
+                recs, scores_orig, n_done = pt.train_chunk(
+                    num_iters, self.shrinkage_rate, self.iter
+                )
+        chunk_wall = _time.perf_counter() - t_chunk0
+        if tracer.enabled and not use_traced and n_done > 0:
+            # fused chunks execute as ONE device program: emit amortized
+            # per-iteration records (flagged) so the trace still has an
+            # iteration axis to join compile/memory signals against
+            per = chunk_wall / n_done
+            for t in range(n_done):
+                ns = recs["num_splits"][t]
+                tracer.emit_iter(
+                    self.iter + t, per, {"fused_chunk": per},
+                    leaves=int(np.sum(ns + (ns > 0))), trees=K,
+                    amortized=True,
+                )
         with timetag.phase("train_score"):
             self.scores = scores_orig[None, :] if K == 1 else scores_orig
+            fence(self.scores)
         chunk_trees = [[] for _ in range(K)]
         for t in range(n_done):
             for k in range(K):
@@ -627,6 +675,7 @@ class GBDT:
             self.ptrainer.hyper = self.hyper
             self.ptrainer.config = self.config
             self.ptrainer._progs.clear()
+            self.ptrainer._traced_progs = None  # hyper is baked in there too
         self.shrinkage_rate = self.config.learning_rate
         self.is_bagging = (
             self.config.bagging_fraction < 1.0 and self.config.bagging_freq > 0

@@ -13,6 +13,7 @@ import numpy as np
 from . import callback as callback_mod
 from .basic import Booster, Dataset
 from .config import canonicalize_params
+from .obs import tracer
 from .utils.log import Log
 
 
@@ -35,6 +36,7 @@ def train(
     callbacks=None,
 ) -> Booster:
     """lgb.train (engine.py:17-199)."""
+    tracer.refresh_from_env()  # LIGHTGBM_TPU_TRACE=trace.jsonl
     params = dict(params or {})
     canon = canonicalize_params(params)
     num_boost_round = int(canon.pop("num_iterations", num_boost_round))
@@ -55,7 +57,14 @@ def train(
     if categorical_feature != "auto":
         train_set.categorical_feature = categorical_feature
 
-    booster = Booster(params=params, train_set=train_set)
+    with tracer.span("booster_init"):
+        booster = Booster(params=params, train_set=train_set)
+    tracer.event(
+        "train_begin", num_boost_round=num_boost_round,
+        objective=str(params.get("objective", "")),
+        num_leaves=str(params.get("num_leaves", "")),
+        num_data=train_set.num_data(),
+    )
     if init_model is not None:
         _apply_init_model(booster, init_model, train_set)
 
@@ -148,9 +157,10 @@ def train(
             i += done
             evaluation_result_list = []
             if valid_sets is not None or eval_train:
-                if eval_train:
-                    evaluation_result_list.extend(booster.eval_train(feval))
-                evaluation_result_list.extend(booster.eval_valid(feval))
+                with tracer.span("eval", iter=i):
+                    if eval_train:
+                        evaluation_result_list.extend(booster.eval_train(feval))
+                    evaluation_result_list.extend(booster.eval_valid(feval))
             try:
                 for cb in cbs_after:
                     cb(callback_mod.CallbackEnv(
@@ -174,9 +184,10 @@ def train(
         finished = booster.update(fobj=fobj)
         evaluation_result_list = []
         if valid_sets is not None or eval_train:
-            if eval_train:
-                evaluation_result_list.extend(booster.eval_train(feval))
-            evaluation_result_list.extend(booster.eval_valid(feval))
+            with tracer.span("eval", iter=i):
+                if eval_train:
+                    evaluation_result_list.extend(booster.eval_train(feval))
+                evaluation_result_list.extend(booster.eval_valid(feval))
         try:
             for cb in cbs_after:
                 cb(callback_mod.CallbackEnv(

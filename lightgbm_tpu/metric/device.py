@@ -7,9 +7,15 @@ its fast path.  These jnp twins keep scores device-resident and transfer
 ONE scalar per metric.  Counterpart of src/metric/binary_metric.hpp /
 regression_metric.hpp / multiclass_metric.hpp evaluated on-accelerator.
 
-Numerics: sums are f32 pairwise reductions (relative error ~1e-6 at 10M
-rows) against the host path's f64; the AUC tie handling is exact (the
-tie-grouped sweep below mirrors binary_metric.hpp:193-259 group order).
+Numerics: the REDUCTIONS (sums / cumsums) accumulate in float64 whenever
+jax x64 is enabled, so the values that feed early-stopping comparisons
+match the host f64 path; per-row math stays f32.  When x64 is
+unavailable (the default TPU config) the f32 accumulation drifts to
+~1e-4..1e-5 at Higgs scale, so the device path is GATED by size:
+``eval_device`` refuses datasets above ``_DEV_F32_ROW_LIMIT`` rows and
+the caller (gbdt._eval_metric) falls back to the host f64 path.  The
+AUC tie handling is exact either way (the tie-grouped sweep below
+mirrors binary_metric.hpp:193-259 group order).
 """
 
 from __future__ import annotations
@@ -19,19 +25,30 @@ import jax.numpy as jnp
 
 _EPS = 1e-15
 
+# above this, f32 accumulation error rivals real metric deltas between
+# early-stopping rounds; without x64 the host path takes over
+_DEV_F32_ROW_LIMIT = 1 << 22
+
+
+def _acc():
+    """Accumulation dtype for reductions: f64 when available.  Evaluated
+    at trace time — flipping jax_enable_x64 mid-process would need a jit
+    cache clear, which nothing in this codebase does."""
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
 
 @jax.jit
 def _binary_logloss_dev(prob, label, weights, sum_weights):
     lab_pos = label > 0
     p = jnp.where(lab_pos, prob, 1.0 - prob)
     pt = -jnp.log(jnp.maximum(p, _EPS))
-    return jnp.sum(pt * weights) / sum_weights
+    return jnp.sum(pt * weights, dtype=_acc()) / sum_weights
 
 
 @jax.jit
 def _binary_error_dev(prob, label, weights, sum_weights):
     err = jnp.where(prob <= 0.5, label > 0, label <= 0).astype(jnp.float32)
-    return jnp.sum(err * weights) / sum_weights
+    return jnp.sum(err * weights, dtype=_acc()) / sum_weights
 
 
 @jax.jit
@@ -42,13 +59,14 @@ def _auc_dev(score, label, weights, sum_weights):
     strictly-greater score plus half the positives of its own tie group.
     Group boundaries propagate via running-max scans instead of the host
     path's segment scatter."""
+    acc = _acc()
     order = jnp.argsort(-score)
     s = score[order]
     lab = label[order]
-    w = weights[order]
+    w = weights[order].astype(acc)
     pos = jnp.where(lab > 0, w, 0.0)
     neg = jnp.where(lab <= 0, w, 0.0)
-    cum_pos = jnp.cumsum(pos)
+    cum_pos = jnp.cumsum(pos, dtype=acc)
     cum_pos_excl = cum_pos - pos
     n = s.shape[0]
     new_thr = jnp.concatenate(
@@ -63,10 +81,10 @@ def _auc_dev(score, label, weights, sum_weights):
     # cum_pos is nondecreasing, so the FIRST end at-or-after each row
     # (this group's end) is the reversed running MIN over end sentinels
     endv = jax.lax.cummin(
-        jnp.where(is_end, cum_pos, jnp.float32(jnp.inf)), reverse=True
+        jnp.where(is_end, cum_pos, acc(jnp.inf)), reverse=True
     )
     pos_g = endv - start
-    accum = jnp.sum(neg * (start + 0.5 * pos_g))
+    accum = jnp.sum(neg * (start + 0.5 * pos_g), dtype=acc)
     sum_pos = cum_pos[n - 1]
     denom = sum_pos * (sum_weights - sum_pos)
     return jnp.where(denom > 0.0, accum / denom, 1.0)
@@ -75,12 +93,12 @@ def _auc_dev(score, label, weights, sum_weights):
 @jax.jit
 def _l2_dev(score, label, weights, sum_weights):
     d = score - label
-    return jnp.sum(d * d * weights) / sum_weights
+    return jnp.sum(d * d * weights, dtype=_acc()) / sum_weights
 
 
 @jax.jit
 def _l1_dev(score, label, weights, sum_weights):
-    return jnp.sum(jnp.abs(score - label) * weights) / sum_weights
+    return jnp.sum(jnp.abs(score - label) * weights, dtype=_acc()) / sum_weights
 
 
 @jax.jit
@@ -90,7 +108,7 @@ def _multi_logloss_dev(prob, label, weights, sum_weights):
     lab = jnp.clip(label.astype(jnp.int32), 0, k - 1)
     p = jnp.take_along_axis(prob, lab[None, :], axis=0)[0]
     pt = -jnp.log(jnp.maximum(p, _EPS))
-    return jnp.sum(pt * weights) / sum_weights
+    return jnp.sum(pt * weights, dtype=_acc()) / sum_weights
 
 
 @jax.jit
@@ -103,7 +121,7 @@ def _multi_error_dev(prob, label, weights, sum_weights):
     true_score = jnp.take_along_axis(prob, lab[None, :], axis=0)  # (1, N)
     n_ge = jnp.sum((prob >= true_score).astype(jnp.int32), axis=0)
     err = (n_ge > 1).astype(jnp.float32)  # the true class always counts once
-    return jnp.sum(err * weights) / sum_weights
+    return jnp.sum(err * weights, dtype=_acc()) / sum_weights
 
 
 class DeviceEval:
@@ -124,13 +142,20 @@ class DeviceEval:
                 self._dev_weights = jnp.asarray(self.weights, jnp.float32)
             else:
                 self._dev_weights = jnp.ones((self.num_data,), jnp.float32)
-            self._dev_sum_w = jnp.float32(self.sum_weights)
+            self._dev_sum_w = jnp.asarray(self.sum_weights, _acc())
         return self._dev_label, self._dev_weights, self._dev_sum_w
 
     def eval_device(self, score, objective=None):
         fn = type(self)._dev_fn
         if fn is None:
             raise NotImplementedError
+        if not jax.config.jax_enable_x64 and self.num_data > _DEV_F32_ROW_LIMIT:
+            # f32 accumulation drifts past early-stopping deltas at this
+            # scale; the caller falls back to the host f64 path
+            raise NotImplementedError(
+                f"device metric gated: {self.num_data} rows > "
+                f"{_DEV_F32_ROW_LIMIT} without x64"
+            )
         label, w, sw = self._dev_cached()
         s = jnp.asarray(score, jnp.float32)
         if self._dev_needs_prob and objective is not None:

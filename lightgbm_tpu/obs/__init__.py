@@ -1,0 +1,20 @@
+"""Observability layer: structured run tracing, compile/retrace
+accounting, memory gauges and trace reports.
+
+Import surface (kept tiny — hot paths touch only ``tracer``/``fence``):
+
+  from lightgbm_tpu.obs import tracer, fence
+  tracer.refresh_from_env()           # LIGHTGBM_TPU_TRACE=trace.jsonl
+  with tracer.span("histogram"): ...
+  with tracer.iteration(i) as rec: rec["leaves"] = 31
+
+Submodules: ``trace`` (spans/counters/gauges/iteration records, JSONL
+sink), ``compilewatch`` (jax.monitoring compile counter + JitWatch
+retrace detector), ``memory`` (host/device gauges), ``report``
+(aggregation + the ``python -m lightgbm_tpu report`` CLI).
+"""
+
+from .trace import Tracer, fence, tracer  # noqa: F401
+from .compilewatch import JitWatch  # noqa: F401
+
+__all__ = ["Tracer", "tracer", "fence", "JitWatch"]

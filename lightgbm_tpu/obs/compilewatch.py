@@ -1,0 +1,145 @@
+"""JAX compile / retrace accountant.
+
+Unexpected retraces are the classic silent TPU perf killer: a jitted
+program whose closure bakes in a trace-time value (an env var, a python
+float) silently recompiles — or worse, silently does NOT pick up a
+changed value — and nothing in the training log shows it.  This module
+provides two layers:
+
+1. A process-global compile counter fed by ``jax.monitoring`` duration
+   events (``/jax/core/compile/backend_compile_duration`` fires once per
+   XLA backend compilation, on every jax version we target).  Each
+   compile also lands in the trace as a ``jax_compile`` event.
+
+2. ``JitWatch`` — a wrapper for jitted entry points that tracks the
+   jit cache size per *array signature* (shapes + dtypes of array
+   arguments).  When the cache grows on a signature that has already
+   been traced, the call is flagged as an **unexpected retrace**
+   (``jax_retrace`` trace event + Log.warning): the cache key changed
+   through something invisible in the arguments — exactly the
+   env-var-read-at-trace-time class of bug.
+
+Both layers are cheap enough to stay on unconditionally: the monitoring
+listener fires only on compiles, and a ``JitWatch`` call adds two cache
+-size reads per invocation (the fused trainer invokes its chunk program
+once per 64 iterations).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ..utils.log import Log
+
+_counts = {"backend_compiles": 0, "backend_compile_secs": 0.0}
+_installed = False
+_watches = []
+
+
+def install() -> None:
+    """Register the jax.monitoring listener (idempotent)."""
+    global _installed
+    if _installed:
+        return
+    import jax.monitoring
+
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    _installed = True
+
+
+def _on_duration(name: str, secs: float, **kwargs) -> None:
+    if name != "/jax/core/compile/backend_compile_duration":
+        return
+    _counts["backend_compiles"] += 1
+    _counts["backend_compile_secs"] += secs
+    from .trace import tracer
+
+    if tracer.enabled:
+        tracer.event("jax_compile", secs=round(secs, 4))
+
+
+def total_compiles() -> int:
+    return _counts["backend_compiles"]
+
+
+def snapshot() -> Dict[str, Any]:
+    """Aggregate compile accounting for bench output / reports."""
+    return {
+        "backend_compiles": _counts["backend_compiles"],
+        "backend_compile_secs": round(_counts["backend_compile_secs"], 3),
+        "watched": {
+            w.name: {
+                "calls": w.calls,
+                "compiles": w.compiles,
+                "retraces": w.retraces,
+                "signatures": len(w._sigs),
+            }
+            for w in _watches
+        },
+    }
+
+
+def _sig_of(args, kwargs):
+    """Array signature: (shape, dtype) per array leaf; non-array leaves
+    are deliberately EXCLUDED so a cache key that shifts without any
+    visible argument change is caught as a retrace."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves((args, kwargs))
+    return tuple(
+        (tuple(l.shape), str(l.dtype))
+        for l in leaves
+        if hasattr(l, "shape") and hasattr(l, "dtype")
+    )
+
+
+class JitWatch:
+    """Wrap a jitted callable; count compilations per array signature and
+    flag cache growth on an already-seen signature as a retrace."""
+
+    def __init__(self, fn, name: str):
+        self._fn = fn
+        self.name = name
+        self.calls = 0
+        self.compiles = 0
+        self.retraces = 0
+        self._sigs = set()
+        install()
+        _watches.append(self)
+
+    def _cache_size(self):
+        cs = getattr(self._fn, "_cache_size", None)
+        if cs is None:
+            return None
+        try:
+            return cs()
+        except Exception:  # pragma: no cover - jax internals moved
+            return None
+
+    def __call__(self, *args, **kwargs):
+        self.calls += 1
+        before = self._cache_size()
+        out = self._fn(*args, **kwargs)
+        if before is None:
+            return out
+        after = self._cache_size()
+        if after is not None and after > before:
+            self.compiles += 1
+            sig = _sig_of(args, kwargs)
+            from .trace import tracer
+
+            if sig in self._sigs:
+                self.retraces += 1
+                Log.warning(
+                    "unexpected retrace of %s (jit cache grew %d -> %d on an "
+                    "already-traced argument signature) — a trace-time "
+                    "constant changed outside the cache key (env var read "
+                    "inside the traced function?)",
+                    self.name, before, after,
+                )
+                tracer.event("jax_retrace", fn=self.name,
+                             cache_size=after)
+            else:
+                self._sigs.add(sig)
+                tracer.event("jax_trace", fn=self.name, cache_size=after)
+        return out

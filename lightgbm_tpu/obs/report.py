@@ -1,0 +1,156 @@
+"""Trace-file summarizer — ``python -m lightgbm_tpu report trace.jsonl``.
+
+Renders a TIMETAG-style table (the reference's destructor dump,
+serial_tree_learner.cpp:12-24, but from structured records instead of
+printf): per-phase totals over the run, per-iteration statistics,
+compile/retrace accounting and memory watermarks.  ``summarize`` is
+also importable — bench.py uses it to fold a (possibly partial) trace of
+a dead run into its failure report.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+
+def load_trace(path: str) -> List[Dict[str, Any]]:
+    """Read a JSONL trace, tolerating a torn final line (the run died
+    mid-write) — partial traces are the point."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                continue  # torn tail record from a killed process
+    return records
+
+
+def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    spans: Dict[str, List[float]] = {}
+    iters: List[Dict[str, Any]] = []
+    compiles = 0
+    compile_secs = 0.0
+    retraces = 0
+    peak_host = 0.0
+    peak_dev = 0.0
+    for r in records:
+        ev = r.get("ev")
+        if ev == "span":
+            agg = spans.setdefault(r.get("name", "?"), [0.0, 0])
+            agg[0] += float(r.get("dur_s", 0.0))
+            agg[1] += 1
+        elif ev == "iter":
+            iters.append(r)
+            peak_host = max(peak_host, float(r.get("host_rss_mb", 0.0)))
+            peak_dev = max(peak_dev, float(r.get("dev_mb", 0.0)))
+        elif ev == "event":
+            name = r.get("name")
+            if name == "jax_compile":
+                compiles += 1
+                compile_secs += float(r.get("secs", 0.0))
+            elif name == "jax_retrace":
+                retraces += 1
+    phase_totals: Dict[str, Dict[str, float]] = {}
+    for it in iters:
+        for k, v in (it.get("phases") or {}).items():
+            agg = phase_totals.setdefault(k, {"total_s": 0.0, "count": 0})
+            agg["total_s"] += float(v)
+            agg["count"] += 1
+    walls = [float(it.get("wall_s", 0.0)) for it in iters]
+    out = {
+        "iterations": len(iters),
+        "total_iter_wall_s": round(sum(walls), 6),
+        "mean_s_per_iter": round(sum(walls) / len(walls), 6) if walls else None,
+        "phases": {
+            k: {"total_s": round(v["total_s"], 6), "count": v["count"],
+                "mean_ms": round(1e3 * v["total_s"] / max(v["count"], 1), 3)}
+            for k, v in sorted(phase_totals.items(),
+                               key=lambda kv: -kv[1]["total_s"])
+        },
+        "spans": {
+            k: {"total_s": round(t, 6), "count": c,
+                "mean_ms": round(1e3 * t / max(c, 1), 3)}
+            for k, (t, c) in sorted(spans.items(), key=lambda kv: -kv[1][0])
+        },
+        "compiles": compiles,
+        "compile_secs": round(compile_secs, 3),
+        "retraces_flagged": retraces,
+        "peak_host_rss_mb": round(peak_host, 1),
+        "peak_dev_mb": round(peak_dev, 1),
+    }
+    if iters:
+        last = iters[-1]
+        out["last_iter"] = int(last.get("iter", -1))
+        if "leaves" in last:
+            out["leaves_last_iter"] = last["leaves"]
+    return out
+
+
+def render(summary: Dict[str, Any], path: str = "") -> str:
+    """TIMETAG-style text table."""
+    lines = []
+    lines.append(f"=== lightgbm_tpu run-trace report{': ' + path if path else ''} ===")
+    n = summary["iterations"]
+    if n:
+        lines.append(
+            f"iterations: {n}   iter wall total: {summary['total_iter_wall_s']:.3f} s"
+            f"   mean: {1e3 * summary['mean_s_per_iter']:.2f} ms/iter"
+        )
+    else:
+        lines.append("iterations: 0 (no iter records — run died before training?)")
+    total_wall = summary["total_iter_wall_s"] or 0.0
+    if summary["phases"]:
+        lines.append("")
+        lines.append(f"{'phase (per-iteration)':<28}{'total_s':>10}{'count':>8}"
+                     f"{'mean_ms':>10}{'% iter':>8}")
+        for name, s in summary["phases"].items():
+            pct = 100.0 * s["total_s"] / total_wall if total_wall else 0.0
+            lines.append(f"{name:<28}{s['total_s']:>10.3f}{s['count']:>8}"
+                         f"{s['mean_ms']:>10.2f}{pct:>8.1f}")
+    if summary["spans"]:
+        lines.append("")
+        lines.append(f"{'span':<28}{'total_s':>10}{'count':>8}{'mean_ms':>10}")
+        for name, s in list(summary["spans"].items())[:20]:
+            lines.append(f"{name:<28}{s['total_s']:>10.3f}{s['count']:>8}"
+                         f"{s['mean_ms']:>10.2f}")
+    lines.append("")
+    lines.append(
+        f"compiles: {summary['compiles']} ({summary['compile_secs']:.1f} s)"
+        f"   unexpected retraces flagged: {summary['retraces_flagged']}"
+    )
+    lines.append(
+        f"memory watermarks: host RSS {summary['peak_host_rss_mb']:.0f} MB"
+        + (f", device {summary['peak_dev_mb']:.0f} MB"
+           if summary["peak_dev_mb"] else "")
+    )
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: List[str]) -> int:
+    """CLI entry: ``python -m lightgbm_tpu report <trace.jsonl> [--json]``."""
+    import sys
+
+    args = [a for a in argv if not a.startswith("--")]
+    as_json = "--json" in argv
+    if not args:
+        sys.stderr.write(
+            "usage: python -m lightgbm_tpu report <trace.jsonl> [--json]\n"
+        )
+        return 2
+    path = args[0]
+    try:
+        records = load_trace(path)
+    except OSError as e:
+        sys.stderr.write(f"cannot read trace {path}: {e}\n")
+        return 1
+    summary = summarize(records)
+    if as_json:
+        sys.stdout.write(json.dumps(summary) + "\n")
+    else:
+        sys.stdout.write(render(summary, path))
+    return 0

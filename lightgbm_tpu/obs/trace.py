@@ -1,0 +1,288 @@
+"""Structured run tracer — the observability core.
+
+The reference ships compile-time TIMETAG phase timers
+(serial_tree_learner.cpp:10-37, gbdt.cpp:22-63) whose only sink is a
+destructor printf.  This tracer is the TPU-era replacement: nested
+host-side spans, counters and gauges written as one-record-per-line JSON
+(JSONL) so a failed run still leaves every record flushed before death,
+plus per-iteration summary records that the bench harness and the
+``python -m lightgbm_tpu report`` CLI aggregate.
+
+Enable with ``LIGHTGBM_TPU_TRACE=/path/to/trace.jsonl`` (re-read at every
+``engine.train``/``GBDT.init``) or programmatically via
+``tracer.configure(path)``.  Disabled mode is near-free: ``span()``
+returns a shared no-op context manager and every other entry point is a
+single attribute check.
+
+Record schema (all records carry ``ev`` and ``ts`` = time.time()):
+
+  {"ev":"meta", "version":1, "pid":..., "argv":[...]}
+  {"ev":"span", "name":..., "dur_s":..., "depth":..., "parent":..., ...attrs}
+  {"ev":"counter"|"gauge", "name":..., "value":..., ...attrs}
+  {"ev":"event", "name":..., ...attrs}
+  {"ev":"iter", "iter":i, "wall_s":..., "phases":{name: secs},
+   "compiles":n, "host_rss_mb":..., "dev_mb":..., ...fields}
+
+Spans opened while an iteration record is open additionally accumulate
+into that iteration's ``phases`` map — that is how the per-phase
+histogram/split/partition breakdown lands on each ``iter`` record.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, Optional
+
+
+class _NullSpan:
+    """Shared no-op context manager returned when tracing is disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tr", "name", "attrs", "_t0")
+
+    def __init__(self, tr: "Tracer", name: str, attrs: Dict[str, Any]):
+        self._tr = tr
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        self._tr._stack.append(self.name)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter() - self._t0
+        tr = self._tr
+        stack = tr._stack
+        if stack and stack[-1] is self.name:
+            stack.pop()
+        rec = {
+            "ev": "span",
+            "name": self.name,
+            "dur_s": round(dur, 9),
+            "depth": len(stack),
+            "parent": stack[-1] if stack else None,
+        }
+        if self.attrs:
+            rec.update(self.attrs)
+        tr._emit(rec)
+        agg = tr._agg.setdefault(self.name, [0.0, 0])
+        agg[0] += dur
+        agg[1] += 1
+        if tr._iter_phases is not None:
+            tr._iter_phases[self.name] = tr._iter_phases.get(self.name, 0.0) + dur
+        return False
+
+
+class Tracer:
+    """Process-global structured tracer with a JSONL sink."""
+
+    def __init__(self):
+        self.enabled = False
+        self.path: Optional[str] = None
+        self._f = None
+        self._lock = threading.Lock()
+        self._stack = []
+        self._agg: Dict[str, list] = {}
+        self._counters: Dict[str, float] = {}
+        self._iter_phases: Optional[Dict[str, float]] = None
+        self._iter_idx = None
+        self._iter_t0 = 0.0
+        self._iter_compiles0 = 0
+        self._atexit_registered = False
+        self._phases_env = None  # cached LIGHTGBM_TPU_TRACE_PHASES
+
+    # -- lifecycle -----------------------------------------------------
+    def refresh_from_env(self) -> None:
+        """(Re-)read LIGHTGBM_TPU_TRACE / LIGHTGBM_TPU_TRACE_PHASES; called
+        at the training entry points so tests and the CLI can toggle
+        tracing without importing this module early."""
+        self._phases_env = os.environ.get("LIGHTGBM_TPU_TRACE_PHASES", "")
+        path = os.environ.get("LIGHTGBM_TPU_TRACE", "")
+        if path and path != self.path:
+            self.configure(path)
+
+    def configure(self, path: str) -> None:
+        """Open (truncate) the JSONL sink at ``path`` and enable tracing."""
+        self.close()
+        self.path = path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(path, "w", buffering=1)  # line buffered
+        self.enabled = True
+        from . import compilewatch
+
+        compilewatch.install()
+        self._emit({
+            "ev": "meta",
+            "version": 1,
+            "pid": os.getpid(),
+            "argv": sys.argv,
+        })
+        if not self._atexit_registered:
+            atexit.register(self.close)
+            self._atexit_registered = True
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.flush()
+                self._f.close()
+            except Exception:  # pragma: no cover - interpreter teardown
+                pass
+        self._f = None
+        self.enabled = False
+
+    def phases_enabled(self, default: bool = False) -> bool:
+        """Per-phase (defused) tracing mode: '1' forces on, '0' forces
+        off, unset/'auto' -> caller's default (the partitioned trainer
+        defaults to ON in interpret mode and OFF on a real TPU, where
+        defusing the chunk program changes the very timings being
+        measured)."""
+        if self._phases_env is None:
+            self._phases_env = os.environ.get("LIGHTGBM_TPU_TRACE_PHASES", "")
+        if self._phases_env == "1":
+            return True
+        if self._phases_env == "0":
+            return False
+        return default
+
+    # -- emission ------------------------------------------------------
+    def _emit(self, rec: Dict[str, Any]) -> None:
+        rec.setdefault("ts", round(time.time(), 6))
+        line = json.dumps(rec, default=str)
+        with self._lock:
+            if self._f is not None:
+                self._f.write(line + "\n")
+
+    def span(self, name: str, **attrs):
+        """Timed nested span context manager (no-op singleton when
+        disabled — near-zero overhead on hot paths)."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, attrs)
+
+    def counter(self, name: str, value: float = 1.0, **attrs) -> None:
+        if not self.enabled:
+            return
+        self._counters[name] = self._counters.get(name, 0.0) + value
+        rec = {"ev": "counter", "name": name, "value": value}
+        rec.update(attrs)
+        self._emit(rec)
+
+    def gauge(self, name: str, value: float, **attrs) -> None:
+        if not self.enabled:
+            return
+        rec = {"ev": "gauge", "name": name, "value": value}
+        rec.update(attrs)
+        self._emit(rec)
+
+    def event(self, name: str, **attrs) -> None:
+        if not self.enabled:
+            return
+        rec = {"ev": "event", "name": name}
+        rec.update(attrs)
+        self._emit(rec)
+
+    # -- per-iteration records -----------------------------------------
+    @contextlib.contextmanager
+    def iteration(self, it: int, **fields):
+        """Open a per-iteration record; spans entered inside accumulate
+        into its ``phases`` map.  Yields a mutable dict callers can add
+        fields to (leaves, bagged_rows, ...).  On close the record gains
+        wall time, compile-count delta and memory gauges."""
+        if not self.enabled:
+            yield None
+            return
+        from . import compilewatch, memory
+
+        prev_phases = self._iter_phases
+        self._iter_phases = {}
+        self._iter_idx = it
+        c0 = compilewatch.total_compiles()
+        t0 = time.perf_counter()
+        rec: Dict[str, Any] = dict(fields)
+        try:
+            yield rec
+        finally:
+            wall = time.perf_counter() - t0
+            out = {
+                "ev": "iter",
+                "iter": int(it),
+                "wall_s": round(wall, 6),
+                "phases": {k: round(v, 6) for k, v in self._iter_phases.items()},
+                "compiles": compilewatch.total_compiles() - c0,
+            }
+            out.update(memory.memory_gauges())
+            out.update(rec)
+            self._emit(out)
+            self._iter_phases = prev_phases
+            self._iter_idx = None
+
+    def emit_iter(self, it: int, wall_s: float, phases: Dict[str, float],
+                  **fields) -> None:
+        """Directly write an iteration record (the fused chunk path emits
+        amortized per-iteration records after the chunk completes)."""
+        if not self.enabled:
+            return
+        from . import memory
+
+        rec = {
+            "ev": "iter",
+            "iter": int(it),
+            "wall_s": round(wall_s, 6),
+            "phases": {k: round(v, 6) for k, v in phases.items()},
+        }
+        rec.update(memory.memory_gauges())
+        rec.update(fields)
+        self._emit(rec)
+
+    # -- aggregates ----------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """Host-side aggregate view (phase totals/counts, counters) —
+        what bench.py embeds into its JSON output."""
+        return {
+            "spans": {
+                name: {"total_s": round(t, 6), "count": c,
+                       "mean_ms": round(1e3 * t / max(c, 1), 3)}
+                for name, (t, c) in sorted(self._agg.items())
+            },
+            "counters": dict(self._counters),
+        }
+
+    def reset_aggregates(self) -> None:
+        self._agg.clear()
+        self._counters.clear()
+
+
+tracer = Tracer()
+
+
+def fence(x):
+    """``jax.block_until_ready`` gate used at phase boundaries: a no-op
+    unless tracing is enabled, so the async dispatch pipeline is never
+    serialized in production runs.  Returns ``x``."""
+    if tracer.enabled and x is not None:
+        import jax
+
+        jax.block_until_ready(x)
+    return x

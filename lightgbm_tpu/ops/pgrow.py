@@ -79,6 +79,23 @@ class PGrowParams(NamedTuple):
     # device then takes the identical best split on its local segment).
     # None/"" = serial.
     axis_name: str = None
+    # level-batched expansion (phase 1) toggles.  These used to be env
+    # reads (LIGHTGBM_TPU_LEVELGROW / LIGHTGBM_TPU_MAXLVL) at trace time
+    # inside the jitted grower — invisible to the jit cache key, so a
+    # mid-process env change silently did nothing.  They are now read
+    # ONCE at trainer construction (boosting/ptrainer.py) and threaded
+    # here, where the static params tuple IS the cache key.
+    levelwise: bool = True
+    max_levels: int = 24
+
+
+def levelgrow_env_params() -> dict:
+    """Read the level-grower env knobs once — construction-time helper
+    for PGrowParams(**levelgrow_env_params())."""
+    return {
+        "levelwise": os.environ.get("LIGHTGBM_TPU_LEVELGROW", "1") != "0",
+        "max_levels": int(os.environ.get("LIGHTGBM_TPU_MAXLVL", "24")),
+    }
 
 
 class BundleMeta(NamedTuple):
@@ -196,7 +213,8 @@ def grow_tree_partitioned(
     per-split ``split_stream`` path in the same loop.  The final tree is
     identical to the per-split grower's; only the kernel-launch count
     changes (~levels instead of ~num_leaves).  Set
-    LIGHTGBM_TPU_LEVELGROW=0 to force the classic path."""
+    LIGHTGBM_TPU_LEVELGROW=0 (read once at trainer construction and
+    threaded through ``params.levelwise``) to force the classic path."""
     L = params.num_leaves
     F = params.num_features
     B = params.num_bins
@@ -211,7 +229,7 @@ def grow_tree_partitioned(
         rows = PLayout(G, bits=params.bits).rows
     per = 32 // params.bits
     mtab = _meta_table(meta, bmeta, F, params.bits)
-    levelwise = os.environ.get("LIGHTGBM_TPU_LEVELGROW", "1") != "0" and L > 4
+    levelwise = params.levelwise and L > 4
 
     def find2(hist2, sums2, depth_ok):
         """Best split for sibling leaves at once: hist2 (2, G/F, B, 3),
@@ -258,7 +276,7 @@ def grow_tree_partitioned(
     if levelwise:
         SMAX = min(-(-(L + 1) // 8) * 8, 512)
         CANDMAX = 2 * SMAX
-        MAXLVL = int(os.environ.get("LIGHTGBM_TPU_MAXLVL", "24"))
+        MAXLVL = params.max_levels
         c_seg0 = jnp.zeros((CANDMAX, 2), jnp.int32).at[0, 1].set(n)
         c_bs0 = jnp.full((CANDMAX, 8), NEG_INF, jnp.float32).at[0].set(root_bs)
         c_leaf0 = jnp.zeros((CANDMAX, 8), jnp.float32).at[0].set(root_leaf)

@@ -318,7 +318,7 @@ def _hist_kernel(sref, p_any, o_ref, acc_ref, buf_ref, sem, *, nf, nb, rows, c, 
             )
         return 0
 
-    jax.lax.fori_loop(0, nblk, body, 0, unroll=False)
+    jax.lax.fori_loop(0, nblk, body, 0)
     o_ref[:, :] = acc_ref[:, :]
 
 
@@ -463,7 +463,7 @@ def _upd_hist_kernel(sref, aux_any, p_any_in, p_any, o_ref, acc_ref, buf_ref, ab
             )
         return 0
 
-    jax.lax.fori_loop(0, nblk, body, 0, unroll=False)
+    jax.lax.fori_loop(0, nblk, body, 0)
     _stream_drain(stage, wsem, nblk)
     o_ref[:, :] = acc_ref[:, :]
 
@@ -640,7 +640,7 @@ def _upd_multi_kernel(sref, aux_any, p_any_in, p_any, o_ref, acc_ref, buf_ref, a
             )
         return 0
 
-    jax.lax.fori_loop(0, nblk, body, 0, unroll=False)
+    jax.lax.fori_loop(0, nblk, body, 0)
     _stream_drain(stage, wsem, nblk)
     o_ref[:, :] = acc_ref[:, :]
 
@@ -750,7 +750,7 @@ def _score_add_kernel(sref, aux_any, p_any_in, p_any, buf_ref, abuf,
         _stream_flush(stage, wsem, p_any, out, j, j * BLK)
         return 0
 
-    jax.lax.fori_loop(0, nblk, body, 0, unroll=False)
+    jax.lax.fori_loop(0, nblk, body, 0)
     _stream_drain(stage, wsem, nblk)
 
 
@@ -1115,7 +1115,6 @@ def _run_segment(
     st = jax.lax.fori_loop(
         0, nblk, body,
         (z, z, z, z, z, z, z, z, jnp.int32(head), nblk * BLK - E),
-        unroll=False,
     )
     if_, ib, cf, cb, kf, kb, fl, fr, cl, cr = st
 
@@ -1212,7 +1211,7 @@ def _level_kernel(
         pltpu.make_async_copy(hacc.at[slot], hist_out.at[s], hsem.at[slot]).start()
         return 0
 
-    jax.lax.fori_loop(0, n_active, one_seg, 0, unroll=False)
+    jax.lax.fori_loop(0, n_active, one_seg, 0)
 
     @pl.when(n_active >= 1)
     def _():
@@ -1439,7 +1438,7 @@ def _update_kernel(aux_any, p_in, p_any, buf, abuf, rsem, asem, wsem, *,
 
         return 0
 
-    jax.lax.fori_loop(0, nblk, body, 0, unroll=False)
+    jax.lax.fori_loop(0, nblk, body, 0)
     # drain: the in-loop wait fires only while reads remain (j+K < nblk),
     # so the last min(R, nblk) writes are still un-waited
     for k in range(min(R, nblk)):

@@ -2,10 +2,13 @@
 phase timers (serial_tree_learner.cpp:10-37, gbdt.cpp:22-63) plus the
 per-iteration wall-clock log (application.cpp:233-236).
 
-TPU-first: phases are ``jax.named_scope`` annotations (visible in XLA/
-jax.profiler traces) wrapped in host-side accumulating timers.  Enable
-with LIGHTGBM_TPU_TIMETAG=1 or ``timetag.enable()``; dumped at exit like
-the reference's destructor prints.
+``PhaseTimers`` is now a thin adapter over the structured tracer
+(obs/trace.py): every phase still emits a ``jax.named_scope`` (so
+xprof/jax.profiler device traces carry the same span names), accumulates
+into the TIMETAG-style totals dumped at exit, AND — when
+``LIGHTGBM_TPU_TRACE`` is set — lands as a structured span in the JSONL
+trace (feeding the per-iteration ``phases`` breakdown).  Enable the
+legacy aggregate dump with LIGHTGBM_TPU_TIMETAG=1 or ``timetag.enable()``.
 """
 
 from __future__ import annotations
@@ -19,11 +22,13 @@ from typing import Dict, Iterator
 
 import jax
 
+from ..obs.trace import tracer
 from .log import Log
 
 
 class PhaseTimers:
-    """Accumulating named phase timers (the TIMETAG duration maps)."""
+    """Accumulating named phase timers (the TIMETAG duration maps),
+    bridged onto the structured tracer."""
 
     def __init__(self):
         self.enabled = bool(int(os.environ.get("LIGHTGBM_TPU_TIMETAG", "0")))
@@ -38,18 +43,21 @@ class PhaseTimers:
             self._dump_registered = True
 
     @contextlib.contextmanager
-    def phase(self, name: str) -> Iterator[None]:
+    def phase(self, name: str, **attrs) -> Iterator[None]:
         """Time a phase; also emits a jax.named_scope so device traces
-        (jax.profiler.trace) carry the same phase names."""
-        if not self.enabled:
+        (jax.profiler.trace) carry the same phase names, and a structured
+        tracer span when the JSONL trace is enabled."""
+        if not self.enabled and not tracer.enabled:
             with jax.named_scope(name):
                 yield
             return
         start = time.perf_counter()
-        with jax.named_scope(name):
-            yield
-        self.totals[name] += time.perf_counter() - start
-        self.counts[name] += 1
+        with tracer.span(name, **attrs):
+            with jax.named_scope(name):
+                yield
+        if self.enabled:
+            self.totals[name] += time.perf_counter() - start
+            self.counts[name] += 1
 
     def dump(self) -> None:
         """TIMETAG destructor-style dump (serial_tree_learner.cpp:12-24)."""

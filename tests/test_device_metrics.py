@@ -87,3 +87,31 @@ def test_auc_device_all_positive_edge(rng):
     (_, host) = m.eval(np.asarray(score, np.float64))[0]
     (_, dev) = m.eval_device(score)[0]
     assert host == 1.0 and dev == 1.0
+
+
+def test_device_path_gated_by_size_without_x64(rng):
+    """Above _DEV_F32_ROW_LIMIT without x64 the device path must refuse
+    (NotImplementedError) so gbdt._eval_metric falls back to host f64 —
+    f32 accumulation drift at Higgs scale corrupted early-stopping
+    comparisons (ADVICE r5)."""
+    import jax
+
+    from lightgbm_tpu.metric.device import _DEV_F32_ROW_LIMIT
+
+    n = 1024  # real rows; num_data is lied upward to trip the gate
+    meta = _Meta()
+    meta.label = (rng.random(n) < 0.4).astype(np.float64)
+    meta.weights = None
+    m = AUCMetric(Config())
+    m.init(meta, n)
+    m.num_data = _DEV_F32_ROW_LIMIT + 1
+    score = rng.standard_normal(n).astype(np.float32)
+    if jax.config.jax_enable_x64:
+        pytest.skip("gate only applies without x64")
+    with pytest.raises(NotImplementedError):
+        m.eval_device(score)
+    # under the limit the device path still runs
+    m.num_data = n
+    (_, dev) = m.eval_device(score)[0]
+    (_, host) = m.eval(np.asarray(score, np.float64))[0]
+    assert dev == pytest.approx(host, rel=2e-5)

@@ -378,3 +378,72 @@ def test_weighted_training(binary_example_data):
     prob = bst.predict(Xt)
     err = np.mean((prob > 0.5) != yt)
     assert err < 0.35
+
+
+def test_valid_dataset_categorical_remap():
+    """A validation Dataset whose pandas category LEVEL ORDER differs
+    from the training frame must be remapped through the training
+    pandas_categorical when reference= is set (ADVICE r5 medium) — and a
+    categorical column-count mismatch must raise like the reference."""
+    pd = pytest.importorskip("pandas")
+    rng = np.random.default_rng(5)
+    n = 1200
+    cats = np.array(["red", "green", "blue", "teal"])
+    cat_col = cats[rng.integers(0, 4, n)]
+    x1 = rng.standard_normal(n)
+    y = ((cat_col == "green") | (cat_col == "teal")).astype(float)
+    df = pd.DataFrame({"c": pd.Categorical(cat_col), "x1": x1})
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "num_leaves": 7, "min_data_in_leaf": 20, "verbose": -1}
+    ds = lgb.Dataset(df, label=y, params=dict(params))
+
+    # same rows, SHUFFLED level order: identical data, so eval on the
+    # valid set must match eval on train exactly after the remap
+    df2 = pd.DataFrame(
+        {"c": pd.Categorical(cat_col, categories=["teal", "blue", "red", "green"]),
+         "x1": x1})
+    evals = {}
+    bst = lgb.train(params, ds, num_boost_round=10,
+                    valid_sets=[lgb.Dataset(df2, label=y, reference=ds)],
+                    valid_names=["shuffled"],
+                    evals_result=evals, verbose_eval=False)
+    # identical rows -> the remapped valid logloss must equal the logloss
+    # of the model's own (remap-verified) predictions on the train frame
+    prob = np.clip(bst.predict(df), 1e-15, 1 - 1e-15)
+    ll = float(-np.mean(y * np.log(prob) + (1 - y) * np.log(1 - prob)))
+    assert evals["shuffled"]["binary_logloss"][-1] == pytest.approx(
+        ll, rel=1e-5)
+    # unseen valid-only level maps to missing, not to a wrong bin
+    df3 = df2.copy()
+    df3["c"] = pd.Categorical(cat_col, categories=list(cats) + ["mauve"])
+    bst.predict(df3.iloc[:10])
+
+    # categorical column-count mismatch raises (reference behavior)
+    df_nocat = pd.DataFrame({"c": np.arange(n, dtype=float), "x1": x1})
+    bad = lgb.Dataset(df_nocat, label=y, reference=ds)
+    with pytest.raises(lgb.LightGBMError, match="do not match"):
+        bad.construct()
+
+
+def test_model_file_crlf_pandas_categorical(tmp_path):
+    """_strip_pandas_categorical span arithmetic: a model file with CRLF
+    line endings (or trailing whitespace on the pandas_categorical line)
+    must load without corrupting the model body (ADVICE r5 low)."""
+    pd = pytest.importorskip("pandas")
+    rng = np.random.default_rng(7)
+    n = 600
+    cat_col = np.array(["a", "b", "c"])[rng.integers(0, 3, n)]
+    df = pd.DataFrame({"c": pd.Categorical(cat_col),
+                       "x": rng.standard_normal(n)})
+    y = (cat_col == "b").astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 5, "verbose": -1},
+                    lgb.Dataset(df, label=y), num_boost_round=3,
+                    verbose_eval=False)
+    ref_pred = bst.predict(df)
+    s = bst.model_to_string()
+    assert "pandas_categorical:" in s
+    crlf = tmp_path / "model_crlf.txt"
+    crlf.write_bytes(s.replace("\n", "\r\n").encode())
+    loaded = lgb.Booster(model_file=str(crlf))
+    assert loaded.pandas_categorical == bst.pandas_categorical
+    np.testing.assert_allclose(loaded.predict(df), ref_pred, rtol=1e-6)

@@ -1,0 +1,340 @@
+"""Observability-layer tests: tracer unit behavior (span nesting, JSONL
+round-trip, disabled-mode overhead), the report CLI, per-iteration record
+schema through real ``engine.train`` runs (mask path and the traced
+partitioned path with its histogram/split/partition phase breakdown),
+and the JitWatch retrace detector.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import report
+from lightgbm_tpu.obs.compilewatch import JitWatch
+from lightgbm_tpu.obs.trace import Tracer, _NULL_SPAN
+
+
+@pytest.fixture
+def fresh_tracer(tmp_path):
+    tr = Tracer()
+    tr.configure(str(tmp_path / "trace.jsonl"))
+    yield tr
+    tr.close()
+
+
+@pytest.fixture
+def global_trace(tmp_path, monkeypatch):
+    """Route the process-global tracer to a temp file for one test, and
+    restore the disabled state afterwards."""
+    from lightgbm_tpu.obs import tracer
+
+    path = str(tmp_path / "trace.jsonl")
+    monkeypatch.setenv("LIGHTGBM_TPU_TRACE", path)
+    yield path
+    tracer.close()
+    tracer.path = None
+    tracer.reset_aggregates()
+
+
+def _read(path):
+    return [json.loads(l) for l in open(path) if l.strip()]
+
+
+class TestTracerUnit:
+    def test_span_nesting_and_jsonl_roundtrip(self, fresh_tracer, tmp_path):
+        tr = fresh_tracer
+        with tr.span("outer", tag="a"):
+            with tr.span("inner"):
+                pass
+            with tr.span("inner"):
+                pass
+        tr.counter("widgets", 3)
+        tr.gauge("temp", 1.5, unit="C")
+        tr.event("boom", detail="x")
+        tr.close()
+        recs = _read(tr.path)
+        assert recs[0]["ev"] == "meta" and recs[0]["version"] == 1
+        spans = [r for r in recs if r["ev"] == "span"]
+        # children close (and are written) before the parent
+        assert [s["name"] for s in spans] == ["inner", "inner", "outer"]
+        assert all(s["parent"] == "outer" and s["depth"] == 1
+                   for s in spans[:2])
+        assert spans[2]["parent"] is None and spans[2]["depth"] == 0
+        assert spans[2]["tag"] == "a"
+        assert all(s["dur_s"] >= 0 for s in spans)
+        counter = next(r for r in recs if r["ev"] == "counter")
+        assert counter["name"] == "widgets" and counter["value"] == 3
+        gauge = next(r for r in recs if r["ev"] == "gauge")
+        assert gauge["value"] == 1.5 and gauge["unit"] == "C"
+        assert any(r["ev"] == "event" and r["name"] == "boom" for r in recs)
+
+    def test_iteration_record(self, fresh_tracer):
+        tr = fresh_tracer
+        with tr.iteration(7) as rec:
+            with tr.span("histogram"):
+                pass
+            with tr.span("split"):
+                pass
+            rec["leaves"] = 31
+        tr.close()
+        it = next(r for r in _read(tr.path) if r["ev"] == "iter")
+        assert it["iter"] == 7 and it["leaves"] == 31
+        assert set(it["phases"]) == {"histogram", "split"}
+        assert it["wall_s"] >= 0 and "host_rss_mb" in it
+        assert "compiles" in it
+
+    def test_disabled_mode_is_noop_and_cheap(self):
+        tr = Tracer()
+        assert not tr.enabled
+        # structural near-zero-overhead proof: the SAME singleton no-op
+        # context manager is returned for every disabled span
+        assert tr.span("x") is _NULL_SPAN
+        assert tr.span("y", attr=1) is _NULL_SPAN
+        tr.counter("c")
+        tr.gauge("g", 1.0)
+        tr.event("e")
+        with tr.iteration(0) as rec:
+            assert rec is None
+        t0 = time.perf_counter()
+        for _ in range(100_000):
+            with tr.span("hot"):
+                pass
+        assert time.perf_counter() - t0 < 1.0  # ~µs/op budget, loose
+
+    def test_snapshot_aggregates(self, fresh_tracer):
+        tr = fresh_tracer
+        for _ in range(3):
+            with tr.span("phase_a"):
+                pass
+        snap = tr.snapshot()
+        assert snap["spans"]["phase_a"]["count"] == 3
+        assert snap["spans"]["phase_a"]["total_s"] >= 0
+
+
+class TestReportCli:
+    def _make_trace(self, tmp_path):
+        tr = Tracer()
+        p = str(tmp_path / "t.jsonl")
+        tr.configure(p)
+        for i in range(4):
+            with tr.iteration(i) as rec:
+                with tr.span("histogram"):
+                    pass
+                with tr.span("split"):
+                    pass
+                rec["leaves"] = 15
+        tr.close()
+        return p
+
+    def test_report_renders_table(self, tmp_path, capsys):
+        from lightgbm_tpu.cli import main
+
+        p = self._make_trace(tmp_path)
+        assert main(["report", p]) == 0
+        out = capsys.readouterr().out
+        assert "run-trace report" in out
+        assert "histogram" in out and "split" in out
+        assert "iterations: 4" in out
+        assert "compiles:" in out
+
+    def test_report_json_mode(self, tmp_path, capsys):
+        from lightgbm_tpu.cli import main
+
+        p = self._make_trace(tmp_path)
+        assert main(["report", p, "--json"]) == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["iterations"] == 4
+        assert "histogram" in summary["phases"]
+
+    def test_report_tolerates_torn_tail(self, tmp_path):
+        p = self._make_trace(tmp_path)
+        with open(p, "a") as f:
+            f.write('{"ev":"iter","iter":99,"wa')  # killed mid-write
+        summary = report.summarize(report.load_trace(p))
+        assert summary["iterations"] == 4
+
+    def test_report_missing_file(self, capsys):
+        from lightgbm_tpu.cli import main
+
+        assert main(["report", "/nonexistent/trace.jsonl"]) == 1
+        assert main(["report"]) == 2
+
+
+def _toy(n=500, f=5, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + X[:, 1] > 0).astype(float)
+    return X, y
+
+
+class TestEngineTraceSchema:
+    def test_mask_path_iteration_records(self, global_trace):
+        X, y = _toy()
+        lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+                  lgb.Dataset(X, label=y), num_boost_round=5,
+                  verbose_eval=False)
+        recs = _read(global_trace)
+        iters = [r for r in recs if r["ev"] == "iter"]
+        assert len(iters) == 5
+        for i, r in enumerate(iters):
+            assert r["iter"] == i
+            assert r["leaves"] > 0 and r["trees"] == 1
+            assert r["wall_s"] > 0 and r["host_rss_mb"] > 0
+            assert "compiles" in r
+            # mask-path phases: the fused grow_tree is one program, so
+            # the breakdown is at driver granularity
+            assert {"boosting", "tree", "train_score"} <= set(r["phases"])
+        assert any(r["ev"] == "event" and r["name"] == "train_begin"
+                   for r in recs)
+
+    def test_traced_partitioned_phase_breakdown(self, global_trace,
+                                                monkeypatch):
+        """The acceptance-criteria run: engine.train with
+        LIGHTGBM_TPU_TRACE produces per-iteration records whose phases
+        carry real device-fenced histogram/split/partition timings."""
+        monkeypatch.setenv("LIGHTGBM_TPU_PGROW", "force")
+        monkeypatch.setenv("LIGHTGBM_TPU_TRACE_PHASES", "1")
+        X, y = _toy(600)
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "verbose": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=3,
+                        verbose_eval=False)
+        assert bst.boosting.ptrainer is not None
+        recs = _read(global_trace)
+        iters = [r for r in recs if r["ev"] == "iter"]
+        assert len(iters) == 3
+        for r in iters:
+            assert {"histogram", "split", "partition", "score_update"} <= set(
+                r["phases"]
+            )
+            assert r["phases"]["histogram"] > 0
+            assert r["phases"]["partition"] > 0
+            assert r["leaves"] > 1
+            assert r["mode"] == "traced"
+        # the report CLI digests it
+        summary = report.summarize(recs)
+        assert summary["iterations"] == 3
+        assert "partition" in summary["phases"]
+
+    def test_fused_chunk_amortized_records(self, global_trace, monkeypatch):
+        monkeypatch.setenv("LIGHTGBM_TPU_PGROW", "force")
+        monkeypatch.setenv("LIGHTGBM_TPU_TRACE_PHASES", "0")
+        X, y = _toy(600)
+        lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+                  lgb.Dataset(X, label=y), num_boost_round=3,
+                  verbose_eval=False)
+        recs = _read(global_trace)
+        iters = [r for r in recs if r["ev"] == "iter"]
+        assert len(iters) == 3
+        assert all(r.get("amortized") for r in iters)
+        assert all("fused_chunk" in r["phases"] for r in iters)
+        # the chunk program itself is spanned and watched
+        assert any(r["ev"] == "span" and r["name"] == "chunk_program"
+                   for r in recs)
+
+    def test_traced_matches_fused_classic(self, tmp_path, monkeypatch):
+        """Traced mode must not change the model: bit-identical to the
+        fused classic (LEVELGROW=0) path on a bagged+feature-sampled
+        config."""
+        monkeypatch.setenv("LIGHTGBM_TPU_PGROW", "force")
+        monkeypatch.setenv("LIGHTGBM_TPU_LEVELGROW", "0")
+        X, y = _toy(1200, 8)
+        params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+                  "min_data_in_leaf": 20, "bagging_fraction": 0.8,
+                  "bagging_freq": 1, "feature_fraction": 0.7}
+        preds = {}
+        from lightgbm_tpu.obs import tracer
+
+        try:
+            for mode in ("0", "1"):
+                monkeypatch.setenv(
+                    "LIGHTGBM_TPU_TRACE", str(tmp_path / f"t{mode}.jsonl")
+                )
+                monkeypatch.setenv("LIGHTGBM_TPU_TRACE_PHASES", mode)
+                bst = lgb.train(dict(params),
+                                lgb.Dataset(X, label=y, params=dict(params)),
+                                num_boost_round=4, verbose_eval=False)
+                preds[mode] = bst.predict(X)
+        finally:
+            tracer.close()
+            tracer.path = None
+        np.testing.assert_array_equal(preds["0"], preds["1"])
+
+
+class TestRetraceDetector:
+    def test_flags_cache_growth_on_seen_signature(self):
+        """The env-var-read-at-trace-time bug class: the jit cache key
+        changes while the visible ARRAY signature does not — JitWatch
+        must flag the recompile as an unexpected retrace."""
+        import jax
+        import jax.numpy as jnp
+
+        fn = jax.jit(lambda x, mode: x * mode, static_argnames=("mode",))
+        w = JitWatch(fn, name="test.retrace")
+        x = jnp.ones((4,))
+        w(x, mode=2)
+        assert w.compiles == 1 and w.retraces == 0
+        w(x, mode=2)  # cache hit
+        assert w.compiles == 1
+        w(x, mode=3)  # same arrays, new static value -> hidden retrace
+        assert w.compiles == 2 and w.retraces == 1
+
+    def test_new_shapes_are_not_retraces(self):
+        import jax
+        import jax.numpy as jnp
+
+        w = JitWatch(jax.jit(lambda x: x + 1), name="test.shapes")
+        w(jnp.ones((3,)))
+        w(jnp.ones((5,)))
+        assert w.compiles == 2 and w.retraces == 0
+        assert len(w._sigs) == 2
+
+    def test_levelgrow_env_participates_in_program_identity(self,
+                                                            monkeypatch):
+        """Satellite regression: LIGHTGBM_TPU_LEVELGROW is read at
+        trainer construction into PGrowParams (static, part of the jit
+        cache key), not at trace time inside the grower."""
+        from lightgbm_tpu.ops.pgrow import levelgrow_env_params
+
+        monkeypatch.setenv("LIGHTGBM_TPU_LEVELGROW", "0")
+        monkeypatch.setenv("LIGHTGBM_TPU_MAXLVL", "7")
+        assert levelgrow_env_params() == {"levelwise": False, "max_levels": 7}
+        monkeypatch.setenv("LIGHTGBM_TPU_LEVELGROW", "1")
+        assert levelgrow_env_params()["levelwise"] is True
+
+        monkeypatch.setenv("LIGHTGBM_TPU_PGROW", "force")
+        X, y = _toy(600)
+        params = {"objective": "binary", "num_leaves": 7, "verbose": -1}
+        monkeypatch.setenv("LIGHTGBM_TPU_LEVELGROW", "0")
+        bst = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                        num_boost_round=1, verbose_eval=False)
+        assert bst.boosting.ptrainer.params.levelwise is False
+        monkeypatch.setenv("LIGHTGBM_TPU_LEVELGROW", "1")
+        bst = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                        num_boost_round=1, verbose_eval=False)
+        assert bst.boosting.ptrainer.params.levelwise is True
+
+
+class TestDisabledOverheadEndToEnd:
+    def test_training_emits_nothing_when_disabled(self, tmp_path,
+                                                  monkeypatch):
+        """With tracing off the instrumented paths must not write records
+        or block dispatch (fence is a no-op)."""
+        from lightgbm_tpu.obs import tracer
+        from lightgbm_tpu.obs.trace import fence
+
+        monkeypatch.delenv("LIGHTGBM_TPU_TRACE", raising=False)
+        tracer.close()
+        tracer.path = None
+        tracer.refresh_from_env()
+        assert not tracer.enabled
+        assert fence(None) is None
+        X, y = _toy()
+        lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+                  lgb.Dataset(X, label=y), num_boost_round=2,
+                  verbose_eval=False)
+        assert not tracer.enabled and tracer.path is None
