@@ -67,6 +67,44 @@ def _report_partial_trace(trace_path, mode):
               file=sys.stderr)
 
 
+def _bench_serving(booster, X, batch_sizes=(1, 128, 2048), reps=20):
+    """Warm p50/p99 latency + throughput of the serving predictor at
+    fixed batch sizes, with compile accounting (serve subsystem)."""
+    from lightgbm_tpu.obs import compilewatch
+    from lightgbm_tpu.serve.artifact import PackedPredictor, PredictorArtifact
+
+    section = {}
+    try:
+        packed = PackedPredictor(PredictorArtifact.from_booster(booster))
+        max_bucket = max(batch_sizes)
+        c0 = compilewatch.total_compiles()
+        warm = packed.warmup(max_bucket)
+        section["warmup_s"] = warm["secs"]
+        section["warmup_compiles"] = warm["compiles"]
+        section["buckets"] = warm["buckets"]
+        c1 = compilewatch.total_compiles()
+        for bs in batch_sizes:
+            bs = min(bs, X.shape[0])
+            rows = np.ascontiguousarray(X[:bs], np.float64)
+            lat = []
+            for _ in range(reps):
+                t0 = time.time()
+                packed.predict(rows)
+                lat.append(time.time() - t0)
+            lat.sort()
+            p50 = lat[len(lat) // 2]
+            p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+            section[f"batch{bs}"] = {
+                "p50_ms": round(1e3 * p50, 3),
+                "p99_ms": round(1e3 * p99, 3),
+                "rows_per_s": round(bs / p50, 1),
+            }
+        section["measure_new_compiles"] = compilewatch.total_compiles() - c1
+    except Exception as e:  # pragma: no cover — serving must not kill bench
+        section["error"] = f"{type(e).__name__}: {e}"
+    return section
+
+
 def _auc(y, s):
     """AUC via the library's own metric (one implementation to trust)."""
     from lightgbm_tpu.config import Config
@@ -92,7 +130,11 @@ def main():
         import subprocess
 
         # fail FAST when the accelerator is unreachable: a dead axon
-        # tunnel makes backend init hang far past any useful timeout
+        # tunnel makes backend init hang far past any useful timeout.
+        # A dead/failed probe downgrades to JAX_PLATFORMS=cpu (flagged as
+        # backend_fallback in the JSON) instead of killing the run: a CPU
+        # number with a flag beats no number (round-5 died here, rc=1).
+        probe_ok = False
         try:
             probe = subprocess.run(
                 [sys.executable, "-c",
@@ -100,16 +142,26 @@ def main():
                  "p = os.environ.get('JAX_PLATFORMS', '');"
                  "p and jax.config.update('jax_platforms', p);"
                  "print(jax.default_backend())"],
-                timeout=180, capture_output=True, text=True,
+                timeout=int(os.environ.get("BENCH_PROBE_TIMEOUT", 180)),
+                capture_output=True, text=True,
             )
-            if probe.returncode != 0 or not (probe.stdout or "").strip():
+            probe_ok = probe.returncode == 0 and bool((probe.stdout or "").strip())
+            if not probe_ok:
                 print("# device backend probe failed:\n"
                       + (probe.stderr or "")[-800:], file=sys.stderr)
-                sys.exit(1)
         except subprocess.TimeoutExpired:
-            print("# device backend init timed out (dead tunnel?) — "
-                  "no benchmark possible", file=sys.stderr)
-            sys.exit(1)
+            print("# device backend init timed out (dead tunnel?)",
+                  file=sys.stderr)
+        if not probe_ok:
+            if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+                # the fallback platform itself is broken — nothing to try
+                print("# cpu backend probe failed — no benchmark possible",
+                      file=sys.stderr)
+                sys.exit(1)
+            print("# falling back to JAX_PLATFORMS=cpu (backend_fallback)",
+                  file=sys.stderr)
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            os.environ["BENCH_BACKEND_FALLBACK"] = "1"
 
         # budget scales with the configured row count (Higgs-scale runs
         # legitimately take much longer than the 1M default)
@@ -281,6 +333,8 @@ def main():
         "learner": "partitioned-fused" if fused else "mask-grower",
         "device": str(jax.devices()[0]).split(":")[0],
     }
+    if os.environ.get("BENCH_BACKEND_FALLBACK") == "1":
+        out["backend_fallback"] = True
 
     # same-box measured CPU baseline (refbuild/measure_baseline.py writes
     # it into BASELINE.json "published"); the GPU number above remains
@@ -327,6 +381,14 @@ def main():
         out["valid_run_total_s"] = round(eval_total, 2)
         out["evalfree_run_total_s"] = round(ref_total, 2)
         out["valid_overhead_ratio"] = round(eval_total / max(ref_total, 1e-9), 3)
+
+    # serving section (docs/SERVING.md): warm inference latency through
+    # the packed-artifact + bucketed-compile-cache path, so BENCH_r*
+    # tracks inference regressions alongside training ones.  Warmup
+    # compiles the bucket ladder; the measured loop must then show zero
+    # new compiles (the serving acceptance contract).
+    if os.environ.get("BENCH_SERVING", "1") != "0":
+        out["serving"] = _bench_serving(booster, X)
 
     # run-trace embedding (docs/OBSERVABILITY.md): the per-phase span
     # totals and compile accounting gathered during THIS run, so the

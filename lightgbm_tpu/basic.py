@@ -432,15 +432,9 @@ class Booster:
         return model_str
 
     def _objective_from_model_string(self, obj_str: str):
-        if not obj_str:
-            return None
-        toks = obj_str.split()
-        params: Dict[str, Any] = {"objective": toks[0]}
-        for t in toks[1:]:
-            if ":" in t:
-                k, _, v = t.partition(":")
-                params[k] = v
-        return create_objective(Config.from_params(params))
+        from .objective import objective_from_string
+
+        return objective_from_string(obj_str)
 
     def _metric_names(self) -> List[str]:
         names = self.config.metric

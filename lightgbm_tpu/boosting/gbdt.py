@@ -700,12 +700,35 @@ class GBDT:
 
     def predict_raw_scores(self, data: np.ndarray, num_iteration: int = -1) -> np.ndarray:
         """(num_pred, N) raw scores over raw (unbinned) features, batched
-        on device (GBDT::PredictRaw)."""
+        on device (GBDT::PredictRaw).
+
+        Batches go through the serving layer's shape-bucketed compile
+        cache (serve/compilecache.py): N is padded up a power-of-two
+        bucket ladder so repeated ad-hoc predicts at varying N reuse a
+        small fixed set of compiled programs instead of recompiling per
+        shape; padding rows are stripped before returning and never
+        change real rows' outputs (row-independent traversal).  Set
+        LIGHTGBM_TPU_PREDICT_BUCKETS=0 for the exact-shape legacy path."""
         models = self._used_models(num_iteration)
         k = self.num_tree_per_iteration
         n = data.shape[0]
         if not models:
             return np.zeros((k, n))
+        import os
+
+        if os.environ.get("LIGHTGBM_TPU_PREDICT_BUCKETS", "1") == "0":
+            return self._predict_raw_scores_unbucketed(data, models, k)
+        key = (len(models), k)
+        cached = getattr(self, "_bucketed_predictor", None)
+        if cached is None or cached[0] != key:
+            from ..serve.compilecache import BucketedRawPredictor
+
+            cached = (key, BucketedRawPredictor.from_models(models, k))
+            self._bucketed_predictor = cached
+        return cached[1].predict_raw_scores(np.asarray(data, np.float64))
+
+    def _predict_raw_scores_unbucketed(self, data: np.ndarray, models, k) -> np.ndarray:
+        n = data.shape[0]
         from ..model.ensemble import split_hi_lo
 
         hi, lo, lo2 = split_hi_lo(np.asarray(data, np.float64))
@@ -772,21 +795,30 @@ class GBDT:
             ).T  # (K, N)
             if raw_score:
                 return raw[0] if raw.shape[0] == 1 else raw.T
-            if self.objective is not None:
-                conv = np.asarray(
-                    self.objective.convert_output(jnp.asarray(raw)), np.float64
-                )
-            else:
-                conv = raw
+            conv = self._convert_output(raw)
             return conv[0] if conv.shape[0] == 1 else conv.T
         raw = self.predict_raw_scores(data, num_iteration)
         if raw_score:
             return raw[0] if raw.shape[0] == 1 else raw.T
-        if self.objective is not None:
-            conv = np.asarray(self.objective.convert_output(jnp.asarray(raw)), np.float64)
-        else:
-            conv = raw
+        conv = self._convert_output(raw)
         return conv[0] if conv.shape[0] == 1 else conv.T
+
+    def _convert_output(self, raw: np.ndarray) -> np.ndarray:
+        """Objective output conversion on (K, N) raw scores.  Like the
+        traversal, the conversion's jnp programs are shape-keyed, so it
+        runs bucket-padded (serve/compilecache.convert_bucketed) unless
+        LIGHTGBM_TPU_PREDICT_BUCKETS=0 pins the exact-shape path."""
+        if self.objective is None:
+            return raw
+        import os
+
+        if os.environ.get("LIGHTGBM_TPU_PREDICT_BUCKETS", "1") == "0":
+            return np.asarray(
+                self.objective.convert_output(jnp.asarray(raw)), np.float64
+            )
+        from ..serve.compilecache import convert_bucketed
+
+        return convert_bucketed(raw, self.objective.convert_output)
 
     # ------------------------------------------------------------------
     def sub_model_name(self) -> str:

@@ -151,15 +151,21 @@ def run_predict(config: Config, params: Dict[str, str]) -> None:
 def main(argv: List[str] = None) -> int:
     """Application::Run (application.h:82, main.cpp:4-21).
 
-    One non-reference extension: ``python -m lightgbm_tpu report
+    Two non-reference extensions: ``python -m lightgbm_tpu report
     <trace.jsonl>`` renders a TIMETAG-style summary of a structured run
-    trace (docs/OBSERVABILITY.md)."""
+    trace (docs/OBSERVABILITY.md), and ``python -m lightgbm_tpu serve
+    model=... [key=value ...]`` runs the microbatching HTTP predict
+    server over a packed artifact or model file (docs/SERVING.md)."""
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] == "report":
         from .obs.report import main as report_main
 
         return report_main(argv[1:])
+    if argv and argv[0] == "serve":
+        from .serve.server import main as serve_main
+
+        return serve_main(argv[1:])
     try:
         params = load_all_params(argv)
         config = Config.from_params(params)

@@ -57,6 +57,23 @@ def create_objective(config) -> ObjectiveFunction:
     return _FACTORY[name](config)
 
 
+def objective_from_string(obj_str: str):
+    """Rebuild an objective from its model-file line — ``name key:value
+    ...`` tokens, the inverse of ``ObjectiveFunction.to_string()``
+    (used when loading model text and packed serving artifacts)."""
+    if not obj_str:
+        return None
+    from ..config import Config
+
+    toks = obj_str.split()
+    params = {"objective": toks[0]}
+    for t in toks[1:]:
+        if ":" in t:
+            k, _, v = t.partition(":")
+            params[k] = v
+    return create_objective(Config.from_params(params))
+
+
 __all__ = [
     "ObjectiveFunction",
     "create_objective",

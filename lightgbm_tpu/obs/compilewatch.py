@@ -80,14 +80,18 @@ def snapshot() -> Dict[str, Any]:
 
 
 def _sig_of(args, kwargs):
-    """Array signature: (shape, dtype) per array leaf; non-array leaves
-    are deliberately EXCLUDED so a cache key that shifts without any
-    visible argument change is caught as a retrace."""
+    """Array signature: (shape, dtype, sharding) per array leaf;
+    non-array leaves are deliberately EXCLUDED so a cache key that
+    shifts without any visible argument change is caught as a retrace.
+    Sharding IS part of jax's cache key (a device_put onto a mesh
+    legitimately recompiles at the same shape), so it belongs in the
+    signature — without it the serving layer's row-sharded predict reads
+    as a false retrace of the single-device program."""
     import jax
 
     leaves = jax.tree_util.tree_leaves((args, kwargs))
     return tuple(
-        (tuple(l.shape), str(l.dtype))
+        (tuple(l.shape), str(l.dtype), str(getattr(l, "sharding", "")))
         for l in leaves
         if hasattr(l, "shape") and hasattr(l, "dtype")
     )
@@ -98,12 +102,18 @@ class JitWatch:
     flag cache growth on an already-seen signature as a retrace."""
 
     def __init__(self, fn, name: str):
+        import threading
+
         self._fn = fn
         self.name = name
         self.calls = 0
         self.compiles = 0
         self.retraces = 0
         self._sigs = set()
+        # serialize calls so a concurrent caller's compile can't land
+        # inside another caller's before/after window and read as that
+        # caller's (false) retrace — the serving batchers share one watch
+        self._lock = threading.Lock()
         install()
         _watches.append(self)
 
@@ -117,6 +127,10 @@ class JitWatch:
             return None
 
     def __call__(self, *args, **kwargs):
+        with self._lock:
+            return self._call_locked(args, kwargs)
+
+    def _call_locked(self, args, kwargs):
         self.calls += 1
         before = self._cache_size()
         out = self._fn(*args, **kwargs)

@@ -19,8 +19,6 @@ Two variants:
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 
@@ -51,9 +49,10 @@ _MR_LO2 = _np.float32(_MR - float(_MR_HI) - float(_MR_LO))
 class TreeArrays:
     """Stacked SoA node arrays for T trees, padded to M = max nodes.
 
-    Built host-side by model/gbdt_model.py. A tree with num_leaves == 1
-    must have node 0 as (left=~0, right=~0) and leaf_value[0] = its
-    constant output (0 for an empty tree).
+    Built host-side from ``model/ensemble.stack_trees`` output (see
+    serve/artifact.py).  A tree with num_leaves == 1 must have node 0 as
+    (left=~0, right=~0) and leaf_value[0] = its constant output (0 for
+    an empty tree).
     """
 
     FIELDS = (
@@ -80,6 +79,31 @@ class TreeArrays:
 
     def tree_tuple(self):
         return tuple(getattr(self, f) for f in self.FIELDS)
+
+    def validate(self) -> "TreeArrays":
+        """Check every field is 2-D and the shapes agree: (T, M) for the
+        node planes, (T, L) for ``leaf_value``.  Raises ValueError naming
+        the first offending field (a shape mismatch here would otherwise
+        surface as an opaque gather error inside the jitted traversal)."""
+        t_m = None
+        for f in self.FIELDS:
+            a = getattr(self, f)
+            shape = tuple(getattr(a, "shape", ()))
+            if len(shape) != 2:
+                raise ValueError(
+                    f"TreeArrays.{f} must be 2-D, got shape {shape}")
+            if f == "leaf_value":
+                if t_m is not None and shape[0] != t_m[0]:
+                    raise ValueError(
+                        f"TreeArrays.leaf_value has {shape[0]} trees but the "
+                        f"node arrays have {t_m[0]}")
+            elif t_m is None:
+                t_m = shape
+            elif shape != t_m:
+                raise ValueError(
+                    f"TreeArrays.{f} has shape {shape}, expected {t_m} "
+                    f"(T, M) like the other node arrays")
+        return self
 
 
 def _traverse_one_tree_binned(bins, feat, thr_bin, zero_bin, dbz, is_cat, left, right):
@@ -189,7 +213,7 @@ def predict_raw(data_hi, data_lo, data_lo2, split_feature_real, threshold_real,
     return jnp.sum(vals, axis=0)
 
 
-@functools.partial(jax.jit, static_argnames=())
+@jax.jit
 def add_leaf_outputs(scores, leaf_id, leaf_outputs):
     """Train-score update: scores += leaf_outputs[leaf_id]
     (ScoreUpdater::AddScore via the learner's data partition,

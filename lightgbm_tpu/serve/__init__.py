@@ -1,0 +1,38 @@
+"""TPU-native inference serving subsystem.
+
+Training produces a ``Booster``; serving heavy traffic needs three more
+things the training stack deliberately does not provide:
+
+1. **Packed artifacts** (``artifact.py``) — the stacked SoA tree arrays
+   (``ops/predict.TreeArrays``) plus objective/class/feature metadata
+   frozen into one versioned ``.npz`` bundle.  A server cold-starts by
+   memory-loading numpy arrays instead of reparsing model text through
+   the host ``Tree`` builder.
+2. **Shape-bucketed compile cache** (``compilecache.py``) — arbitrary
+   request sizes are padded up a power-of-two bucket ladder so every
+   batch shape hits one of a small fixed set of compiled programs;
+   ``warmup()`` precompiles the ladder and the obs compile accountant
+   flags anything that still compiles after it.
+3. **Microbatching** (``batcher.py``) + a stdlib-HTTP front end
+   (``server.py``, ``python -m lightgbm_tpu serve``) — concurrent
+   requests coalesce into device-sized batches under
+   ``max_batch_size``/``max_delay_ms`` with bounded queueing and
+   overload shedding.
+
+See docs/SERVING.md for the artifact format and operational knobs.
+"""
+
+from .artifact import PackedPredictor, PredictorArtifact
+from .batcher import MicroBatcher, RequestTimeout, ServerOverloaded
+from .compilecache import BucketedRawPredictor, bucket_for, bucket_ladder
+
+__all__ = [
+    "PredictorArtifact",
+    "PackedPredictor",
+    "BucketedRawPredictor",
+    "bucket_for",
+    "bucket_ladder",
+    "MicroBatcher",
+    "ServerOverloaded",
+    "RequestTimeout",
+]

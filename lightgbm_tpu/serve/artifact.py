@@ -1,0 +1,244 @@
+"""Packed predictor artifacts — the serving-side model format.
+
+A trained model's inference state is exactly the stacked SoA node arrays
+that ``ops/predict.predict_raw`` traverses (``TreeArrays``) plus a small
+metadata record (objective string, class count, feature names).  The
+training-side model text format (``GBDT::SaveModelToString``) keeps
+reference compatibility but pays a full host-side reparse through
+``Tree.from_string`` + ``stack_trees`` on every cold start; a packed
+artifact freezes the post-``stack_trees`` arrays into one versioned
+``.npz`` so a server loads with ``np.load`` and starts answering after
+``warmup()``.
+
+Format (``.npz``, version 1):
+  ``__meta__``           0-d array holding one JSON string (see META_KEYS)
+  ``<TreeArrays field>`` one entry per ``TreeArrays.FIELDS`` name, with
+                         the (T, M)/(T, L) shapes ``TreeArrays.validate``
+                         checks.  Tree order is model order (class of
+                         tree ``i`` is ``i % num_tree_per_iteration``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..ops.predict import TreeArrays
+from ..utils.log import Log
+
+FORMAT_VERSION = 1
+META_KEYS = (
+    "format_version",
+    "num_class",
+    "num_tree_per_iteration",
+    "num_trees",
+    "num_features",
+    "objective",
+    "boost_from_average",
+    "feature_names",
+    "pandas_categorical",
+)
+
+# stack_trees() dict key -> TreeArrays field name (the stacker predates
+# TreeArrays and names the real-feature plane "split_feature")
+_STACK_TO_FIELD = {
+    "split_feature_inner": "split_feature",
+    "split_feature": "split_feature_real",
+    "threshold_bin": "threshold_bin",
+    "threshold_real": "threshold_real",
+    "threshold_real_lo": "threshold_real_lo",
+    "threshold_real_lo2": "threshold_real_lo2",
+    "zero_bin": "zero_bin",
+    "default_bin_for_zero": "default_bin_for_zero",
+    "default_value": "default_value_real",
+    "default_value_lo": "default_value_real_lo",
+    "default_value_lo2": "default_value_real_lo2",
+    "is_categorical": "is_categorical",
+    "left_child": "left_child",
+    "right_child": "right_child",
+    "leaf_value": "leaf_value",
+}
+
+
+def stacked_tree_arrays(models: List) -> TreeArrays:
+    """Stack host Trees into a host-side (numpy) ``TreeArrays``."""
+    from ..model.ensemble import stack_trees
+
+    stacked = stack_trees(models)
+    fields = {
+        _STACK_TO_FIELD[k]: np.asarray(v)
+        for k, v in stacked.items()
+        if k in _STACK_TO_FIELD
+    }
+    return TreeArrays(**fields).validate()
+
+
+class PredictorArtifact:
+    """Host-side packed model: a ``TreeArrays`` + metadata dict."""
+
+    def __init__(self, arrays: TreeArrays, meta: Dict):
+        self.arrays = arrays
+        self.meta = dict(meta)
+        self.validate()
+
+    # -- construction --------------------------------------------------
+    @classmethod
+    def from_booster(cls, booster, num_iteration: int = -1) -> "PredictorArtifact":
+        """Freeze a trained/loaded ``Booster``'s inference state."""
+        b = booster.boosting
+        models = b._used_models(num_iteration)
+        if not models:
+            Log.fatal("Cannot pack an artifact from a model with no trees")
+        if b.objective is not None:
+            objective = b.objective.to_string()
+        else:
+            objective = getattr(b, "objective_name_loaded", "") or ""
+        meta = {
+            "format_version": FORMAT_VERSION,
+            "num_class": int(b.num_class),
+            "num_tree_per_iteration": int(b.num_tree_per_iteration),
+            "num_trees": len(models),
+            "num_features": int(b.max_feature_idx) + 1,
+            "objective": objective,
+            "boost_from_average": bool(b.boost_from_average_),
+            "feature_names": list(b.feature_names or []),
+            "pandas_categorical": getattr(booster, "pandas_categorical", []) or [],
+        }
+        return cls(stacked_tree_arrays(models), meta)
+
+    # -- persistence ---------------------------------------------------
+    def save(self, path: str) -> str:
+        payload = {f: getattr(self.arrays, f) for f in TreeArrays.FIELDS}
+        payload["__meta__"] = np.asarray(json.dumps(self.meta))
+        np.savez_compressed(path, **payload)
+        # np.savez appends .npz when missing — report the real path
+        return path if path.endswith(".npz") else path + ".npz"
+
+    @classmethod
+    def load(cls, path: str) -> "PredictorArtifact":
+        with np.load(path, allow_pickle=False) as z:
+            if "__meta__" not in z:
+                Log.fatal("%s is not a packed predictor artifact (no __meta__)", path)
+            meta = json.loads(str(z["__meta__"]))
+            version = int(meta.get("format_version", -1))
+            if version != FORMAT_VERSION:
+                Log.fatal(
+                    "Unsupported artifact format_version %s (supported: %d)",
+                    version, FORMAT_VERSION,
+                )
+            missing = [f for f in TreeArrays.FIELDS if f not in z]
+            if missing:
+                Log.fatal("Artifact %s is missing tree arrays: %s", path, missing)
+            arrays = TreeArrays(**{f: z[f] for f in TreeArrays.FIELDS})
+        return cls(arrays, meta)
+
+    # -- checks --------------------------------------------------------
+    def validate(self) -> "PredictorArtifact":
+        self.arrays.validate()
+        for key in META_KEYS:
+            if key not in self.meta:
+                Log.fatal("Artifact metadata is missing %r", key)
+        t = self.arrays.split_feature.shape[0]
+        if t != int(self.meta["num_trees"]):
+            Log.fatal(
+                "Artifact metadata says %s trees but arrays hold %d",
+                self.meta["num_trees"], t,
+            )
+        k = int(self.meta["num_tree_per_iteration"])
+        if k <= 0 or t % k != 0:
+            Log.fatal(
+                "Artifact tree count %d is not a multiple of "
+                "num_tree_per_iteration %d", t, k,
+            )
+        return self
+
+    # -- conveniences --------------------------------------------------
+    @property
+    def num_class(self) -> int:
+        return int(self.meta["num_class"])
+
+    @property
+    def num_tree_per_iteration(self) -> int:
+        return int(self.meta["num_tree_per_iteration"])
+
+    @property
+    def num_features(self) -> int:
+        return int(self.meta["num_features"])
+
+    def make_objective(self):
+        """Rebuild the objective from its model-string form (the same
+        ``name key:value ...`` tokens Booster writes/loads)."""
+        from ..objective import objective_from_string
+
+        return objective_from_string(self.meta.get("objective", ""))
+
+
+class PackedPredictor:
+    """Device-side serving predictor over a ``PredictorArtifact``:
+    bucketed raw traversal + the objective's output conversion, with the
+    same output shapes as ``Booster.predict``."""
+
+    def __init__(self, artifact: PredictorArtifact):
+        from .compilecache import BucketedRawPredictor
+
+        self.artifact = artifact
+        self.objective = artifact.make_objective()
+        self.raw = BucketedRawPredictor.from_tree_arrays(
+            artifact.arrays, artifact.num_tree_per_iteration
+        )
+
+    @property
+    def num_features(self) -> int:
+        return self.artifact.num_features
+
+    def warmup(self, max_rows: int, buckets: Optional[List[int]] = None) -> Dict:
+        """Precompile the bucket ladder through the FULL predict path —
+        traversal AND the objective's output conversion — so a warmed
+        predictor answers any covered request size with zero new
+        compiles (the PR acceptance contract; raw traversal alone would
+        leave the conversion ops compiling per bucket on first use)."""
+        import time
+
+        from ..obs import compilewatch, tracer
+        from .compilecache import bucket_ladder
+
+        if buckets is None:
+            buckets = bucket_ladder(
+                max_rows, self.raw.min_bucket, self.raw._row_multiple
+            )
+        c0 = compilewatch.total_compiles()
+        t0 = time.perf_counter()
+        with tracer.span("serve_warmup", buckets=len(buckets)):
+            for b in buckets:
+                self.predict(np.zeros((b, self.num_features)))
+        stats = {
+            "buckets": list(buckets),
+            "compiles": compilewatch.total_compiles() - c0,
+            "secs": round(time.perf_counter() - t0, 4),
+        }
+        tracer.event("serve_warmup_done", **stats)
+        return stats
+
+    def predict(self, data: np.ndarray, raw_score: bool = False) -> np.ndarray:
+        """(N,) or (N, K) predictions, matching ``Booster.predict``."""
+        data = np.asarray(data, np.float64)
+        if data.ndim == 1:
+            data = data.reshape(1, -1)
+        if data.shape[1] < self.num_features:
+            Log.fatal(
+                "Predict data has %d features but the model needs %d",
+                data.shape[1], self.num_features,
+            )
+        raw = self.raw.predict_raw_scores(data)  # (K, N) f64
+        if raw_score:
+            return raw[0] if raw.shape[0] == 1 else raw.T
+        if self.objective is not None:
+            from .compilecache import convert_bucketed
+
+            conv = convert_bucketed(raw, self.objective.convert_output,
+                                    self.raw.min_bucket)
+        else:
+            conv = raw
+        return conv[0] if conv.shape[0] == 1 else conv.T

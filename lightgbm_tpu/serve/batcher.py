@@ -1,0 +1,225 @@
+"""Microbatching request engine.
+
+TPU traversal throughput comes from batch size: a single-row dispatch
+pays the same dispatch + program overhead as a 1024-row one.  The
+batcher makes concurrent single/small requests share that cost: callers
+block in ``submit()`` while a background thread coalesces queued
+requests into one device batch, bounded by ``max_batch_size`` rows and
+``max_delay_ms`` of added latency for the request at the head of the
+queue.
+
+Overload policy is shed-not-queue: the pending-row budget is a hard
+bound, and a ``submit()`` that would exceed it raises
+``ServerOverloaded`` immediately instead of stretching everyone's
+latency (the caller sees a 503 and can retry against another replica).
+Requests whose caller deadline expires while still queued are dropped
+before they waste device time.
+
+Metrics (queue depth, batch occupancy, shed/timeout counts, latency
+quantiles) are kept in-process for ``stats()`` and mirrored to the obs
+tracer when tracing is enabled.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..obs import tracer
+
+
+class ServerOverloaded(RuntimeError):
+    """The pending-row queue is full; the request was shed."""
+
+
+class RequestTimeout(RuntimeError):
+    """The request's deadline expired before a batch picked it up."""
+
+
+class _Request:
+    __slots__ = ("rows", "deadline", "done", "result", "error", "t_enqueue")
+
+    def __init__(self, rows: np.ndarray, deadline: float):
+        self.rows = rows
+        self.deadline = deadline
+        self.done = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.t_enqueue = time.perf_counter()
+
+
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[i]
+
+
+class MicroBatcher:
+    """Coalesce concurrent ``submit(rows)`` calls into bounded batches.
+
+    ``predict_fn(batch) -> per-row outputs`` must return an array whose
+    leading axis is the batch row axis ((N,) or (N, K)) — exactly the
+    ``PackedPredictor.predict`` contract.
+    """
+
+    def __init__(
+        self,
+        predict_fn: Callable[[np.ndarray], np.ndarray],
+        max_batch_size: int = 1024,
+        max_delay_ms: float = 5.0,
+        max_queue_rows: int = 8192,
+        request_timeout_ms: float = 2000.0,
+        latency_window: int = 2048,
+    ):
+        self.predict_fn = predict_fn
+        self.max_batch_size = int(max_batch_size)
+        self.max_delay_ms = float(max_delay_ms)
+        self.max_queue_rows = int(max_queue_rows)
+        self.request_timeout_ms = float(request_timeout_ms)
+
+        self._queue: collections.deque = collections.deque()
+        self._queued_rows = 0
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._closed = False
+        self._counts = {"requests": 0, "rows": 0, "batches": 0,
+                        "shed": 0, "timeouts": 0, "errors": 0}
+        self._occupancy: collections.deque = collections.deque(maxlen=256)
+        self._latency_s: collections.deque = collections.deque(maxlen=latency_window)
+        self._thread = threading.Thread(
+            target=self._loop, name="lightgbm-tpu-batcher", daemon=True
+        )
+        self._thread.start()
+
+    # -- client side ---------------------------------------------------
+    def submit(self, rows: np.ndarray, timeout_ms: Optional[float] = None) -> np.ndarray:
+        """Block until the batch containing ``rows`` completes; returns
+        the per-row outputs for exactly these rows.  Raises
+        ``ServerOverloaded`` (queue full), ``RequestTimeout`` (deadline
+        expired before execution), or the predict error."""
+        rows = np.asarray(rows, np.float64)
+        if rows.ndim == 1:
+            rows = rows.reshape(1, -1)
+        if rows.shape[0] == 0:
+            return np.empty((0,))
+        tmo = self.request_timeout_ms if timeout_ms is None else float(timeout_ms)
+        req = _Request(rows, deadline=time.monotonic() + tmo / 1e3)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            if self._queued_rows + rows.shape[0] > self.max_queue_rows:
+                self._counts["shed"] += 1
+                tracer.counter("serve_shed")
+                raise ServerOverloaded(
+                    f"queue holds {self._queued_rows} rows; "
+                    f"+{rows.shape[0]} exceeds max_queue_rows="
+                    f"{self.max_queue_rows}"
+                )
+            self._counts["requests"] += 1
+            self._counts["rows"] += rows.shape[0]
+            self._queue.append(req)
+            self._queued_rows += rows.shape[0]
+            self._wake.notify()
+        # wait past the deadline by a grace period: an in-flight batch
+        # holding this request may still complete it
+        req.done.wait(tmo / 1e3 + 60.0)
+        if req.error is not None:
+            raise req.error
+        if req.result is None:
+            raise RequestTimeout("request was never executed")
+        self._latency_s.append(time.perf_counter() - req.t_enqueue)
+        return req.result
+
+    # -- batch loop ----------------------------------------------------
+    def _take_batch(self) -> List[_Request]:
+        """Pop up to max_batch_size rows' worth of requests, waiting at
+        most max_delay_ms after the first arrival; expired requests are
+        failed here rather than executed."""
+        with self._lock:
+            while not self._queue and not self._closed:
+                self._wake.wait(0.1)
+            if self._closed and not self._queue:
+                return []
+            batch_deadline = time.monotonic() + self.max_delay_ms / 1e3
+            taken: List[_Request] = []
+            rows = 0
+            while True:
+                while self._queue:
+                    req = self._queue[0]
+                    if time.monotonic() > req.deadline:
+                        self._queue.popleft()
+                        self._queued_rows -= req.rows.shape[0]
+                        self._counts["timeouts"] += 1
+                        tracer.counter("serve_request_timeout")
+                        req.error = RequestTimeout(
+                            "deadline expired while queued")
+                        req.done.set()
+                        continue
+                    if rows and rows + req.rows.shape[0] > self.max_batch_size:
+                        return taken
+                    self._queue.popleft()
+                    self._queued_rows -= req.rows.shape[0]
+                    taken.append(req)
+                    rows += req.rows.shape[0]
+                    if rows >= self.max_batch_size:
+                        return taken
+                remaining = batch_deadline - time.monotonic()
+                if remaining <= 0 or self._closed:
+                    return taken
+                self._wake.wait(remaining)
+
+    def _loop(self) -> None:
+        while True:
+            taken = self._take_batch()
+            if not taken:
+                if self._closed:
+                    return
+                continue
+            batch = (taken[0].rows if len(taken) == 1
+                     else np.concatenate([r.rows for r in taken], axis=0))
+            self._occupancy.append(batch.shape[0])
+            tracer.gauge("serve_queue_depth", float(self._queued_rows))
+            tracer.gauge("serve_batch_rows", float(batch.shape[0]))
+            try:
+                with tracer.span("serve_batch", rows=batch.shape[0],
+                                 requests=len(taken)):
+                    out = self.predict_fn(batch)
+                self._counts["batches"] += 1
+            except BaseException as e:  # predict failure fans out to callers
+                self._counts["errors"] += 1
+                for req in taken:
+                    req.error = e
+                    req.done.set()
+                continue
+            start = 0
+            for req in taken:
+                n = req.rows.shape[0]
+                req.result = np.asarray(out[start:start + n])
+                start += n
+                req.done.set()
+
+    # -- ops surface ---------------------------------------------------
+    def stats(self) -> Dict:
+        with self._lock:
+            counts = dict(self._counts)
+            depth = self._queued_rows
+        lat = sorted(self._latency_s)
+        occ = list(self._occupancy)
+        return {
+            **counts,
+            "queue_rows": depth,
+            "batch_occupancy_mean": round(float(np.mean(occ)), 2) if occ else 0.0,
+            "latency_p50_ms": round(1e3 * _quantile(lat, 0.50), 3),
+            "latency_p99_ms": round(1e3 * _quantile(lat, 0.99), 3),
+        }
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._wake.notify_all()
+        self._thread.join(timeout=5.0)

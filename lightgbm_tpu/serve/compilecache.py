@@ -1,0 +1,229 @@
+"""Shape-bucketed predict compile cache.
+
+``ops/predict.predict_raw`` is an ordinary ``jax.jit`` program whose
+cache key includes the batch shape: a server answering arbitrary request
+sizes would recompile for every new N (seconds of XLA work on a latency
+path).  Here every incoming batch is padded up a power-of-two bucket
+ladder, so any request size N hits one of ``log2(max_rows)`` compiled
+programs.  Padded rows are zeros; tree traversal is row-independent, so
+real rows' outputs are bit-identical to an unpadded call and the padding
+is stripped before returning.
+
+``warmup()`` precompiles the whole ladder up front and reports through
+the obs tracer; the module-level ``JitWatch`` wrapper flags any compile
+that still happens after warmup as an unexpected retrace, which is the
+serving-loop equivalent of the training-side retrace detector
+(docs/OBSERVABILITY.md).
+
+Multi-device hosts can traverse with the batch row-sharded over the
+local mesh (``shard=True``): the bucket is padded to a multiple of the
+device count and the data planes are placed with a ``NamedSharding``
+over the ``parallel/`` one-axis mesh, letting XLA partition the
+traversal; tree arrays are replicated once at construction.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..obs import JitWatch, tracer
+from ..obs import compilewatch
+from ..ops.predict import TreeArrays, predict_raw
+from ..utils.log import Log
+
+DEFAULT_MIN_BUCKET = 8
+
+# the per-class tree-array arguments of predict_raw, in call order
+# (after the three data planes)
+_TREE_ARG_FIELDS = (
+    "split_feature_real",
+    "threshold_real",
+    "threshold_real_lo",
+    "threshold_real_lo2",
+    "default_value_real",
+    "default_value_real_lo",
+    "default_value_real_lo2",
+    "is_categorical",
+    "left_child",
+    "right_child",
+    "leaf_value",
+)
+
+# one shared watch: every bucketed predict in the process (Booster.predict
+# and the serving subsystem) is accounted under "serve.predict_raw"
+_watched_predict_raw: Optional[JitWatch] = None
+
+
+def _watch() -> JitWatch:
+    global _watched_predict_raw
+    if _watched_predict_raw is None:
+        _watched_predict_raw = JitWatch(predict_raw, "serve.predict_raw")
+    return _watched_predict_raw
+
+
+def bucket_for(n: int, min_bucket: int = DEFAULT_MIN_BUCKET,
+               multiple_of: int = 1) -> int:
+    """Smallest power-of-two >= max(n, min_bucket), rounded up to a
+    multiple of ``multiple_of`` (device count when row-sharding)."""
+    if n <= 0:
+        n = 1
+    b = max(int(min_bucket), 1)
+    while b < n:
+        b <<= 1
+    if multiple_of > 1 and b % multiple_of:
+        b += multiple_of - (b % multiple_of)
+    return b
+
+
+def bucket_ladder(max_rows: int, min_bucket: int = DEFAULT_MIN_BUCKET,
+                  multiple_of: int = 1) -> List[int]:
+    """The distinct buckets covering request sizes 1..max_rows."""
+    ladder = []
+    n = 1
+    while True:
+        b = bucket_for(n, min_bucket, multiple_of)
+        if not ladder or b != ladder[-1]:
+            ladder.append(b)
+        if b >= max_rows:
+            return ladder
+        n = b + 1
+
+
+def convert_bucketed(scores: np.ndarray, convert_fn,
+                     min_bucket: int = DEFAULT_MIN_BUCKET) -> np.ndarray:
+    """Apply an objective's output conversion on bucket-padded (K, N)
+    raw scores, so its compiled programs are bucket-shaped like the
+    traversal's (the un-jitted jnp ops inside ``convert_output`` would
+    otherwise compile per exact N — the same silent per-shape compile
+    the traversal bucketing exists to kill).  Conversions are column-
+    local (elementwise sigmoid, per-column softmax), so zero-padded
+    columns never influence real columns and are stripped on return."""
+    import jax.numpy as jnp
+
+    scores = np.asarray(scores, np.float64)
+    n = scores.shape[1]
+    b = bucket_for(n, min_bucket)
+    if b != n:
+        scores = np.pad(scores, ((0, 0), (0, b - n)))
+    return np.asarray(convert_fn(jnp.asarray(scores)), np.float64)[:, :n]
+
+
+class BucketedRawPredictor:
+    """Raw-score predictor over per-class stacked tree arrays with
+    bucket-padded batches.  ``predict_raw_scores`` mirrors
+    ``GBDT.predict_raw_scores``'s (K, N) float64 contract."""
+
+    def __init__(self, class_arrays: List[tuple], min_bucket: int = DEFAULT_MIN_BUCKET,
+                 shard: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        self.num_class_arrays = len(class_arrays)
+        self.min_bucket = int(min_bucket)
+        self._sharding = None
+        self._row_multiple = 1
+        if shard:
+            devs = jax.local_devices()
+            if len(devs) > 1:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                from ..parallel import make_mesh
+
+                mesh = make_mesh()
+                self._sharding = NamedSharding(mesh, P("data"))
+                self._replicated = NamedSharding(mesh, P())
+                self._row_multiple = len(devs)
+                class_arrays = [
+                    tuple(jax.device_put(a, self._replicated) for a in args)
+                    for args in class_arrays
+                ]
+        self.class_arrays = [
+            tuple(jnp.asarray(a) for a in args) for args in class_arrays
+        ]
+
+    # -- construction --------------------------------------------------
+    @classmethod
+    def from_tree_arrays(cls, arrays: TreeArrays, num_tree_per_iteration: int,
+                         **kw) -> "BucketedRawPredictor":
+        """Split the (T, ...) stacked arrays into per-class tuples
+        (class of tree i is i % k, matching GBDT.predict_raw_scores)."""
+        arrays.validate()
+        t = arrays.split_feature.shape[0]
+        k = int(num_tree_per_iteration)
+        if k <= 0 or t % k != 0:
+            Log.fatal("%d stacked trees are not a multiple of "
+                      "num_tree_per_iteration=%d", t, k)
+        class_arrays = []
+        for kk in range(k):
+            idx = np.arange(kk, t, k)
+            class_arrays.append(tuple(
+                np.asarray(getattr(arrays, f))[idx] for f in _TREE_ARG_FIELDS
+            ))
+        return cls(class_arrays, **kw)
+
+    @classmethod
+    def from_models(cls, models: List, num_tree_per_iteration: int,
+                    **kw) -> "BucketedRawPredictor":
+        from .artifact import stacked_tree_arrays
+
+        return cls.from_tree_arrays(
+            stacked_tree_arrays(models), num_tree_per_iteration, **kw
+        )
+
+    # -- predict -------------------------------------------------------
+    def bucket(self, n: int) -> int:
+        return bucket_for(n, self.min_bucket, self._row_multiple)
+
+    def _data_planes(self, data: np.ndarray, bucket: int):
+        """Triple-float planes of ``data`` padded to ``bucket`` rows."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..model.ensemble import split_hi_lo
+
+        hi, lo, lo2 = split_hi_lo(np.asarray(data, np.float64))
+        pad = bucket - data.shape[0]
+        if pad:
+            hi = np.pad(hi, ((0, pad), (0, 0)))
+            lo = np.pad(lo, ((0, pad), (0, 0)))
+            lo2 = np.pad(lo2, ((0, pad), (0, 0)))
+        planes = (jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(lo2))
+        if self._sharding is not None:
+            planes = tuple(jax.device_put(p, self._sharding) for p in planes)
+        return planes
+
+    def predict_raw_scores(self, data: np.ndarray) -> np.ndarray:
+        """(K, N) float64 raw scores for (N, F) raw features."""
+        n = data.shape[0]
+        bucket = self.bucket(n)
+        planes = self._data_planes(data, bucket)
+        fn = _watch()
+        out = np.empty((self.num_class_arrays, n))
+        for kk, args in enumerate(self.class_arrays):
+            out[kk] = np.asarray(fn(*planes, *args), np.float64)[:n]
+        tracer.counter("serve_predict_rows", float(n))
+        return out
+
+    # -- warmup --------------------------------------------------------
+    def warmup(self, max_rows: int, num_features: int,
+               buckets: Optional[List[int]] = None) -> Dict:
+        """Precompile the bucket ladder up to ``max_rows`` rows.  Returns
+        (and traces) the buckets touched and the compile count — after
+        this, any request of size <= max(buckets) must hit the cache."""
+        if buckets is None:
+            buckets = bucket_ladder(max_rows, self.min_bucket, self._row_multiple)
+        c0 = compilewatch.total_compiles()
+        t0 = time.perf_counter()
+        with tracer.span("serve_warmup", buckets=len(buckets)):
+            for b in buckets:
+                self.predict_raw_scores(np.zeros((b, num_features)))
+        stats = {
+            "buckets": list(buckets),
+            "compiles": compilewatch.total_compiles() - c0,
+            "secs": round(time.perf_counter() - t0, 4),
+        }
+        tracer.event("serve_warmup_done", **stats)
+        return stats

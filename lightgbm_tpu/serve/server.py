@@ -1,0 +1,246 @@
+"""Stdlib-HTTP JSONL predict server — ``python -m lightgbm_tpu serve``.
+
+Endpoints:
+  POST /predict      body: one JSON row per line — either ``[f0, f1, ...]``
+                     or ``{"features": [...]}``.  Response: one JSON
+                     prediction per line, same order (a float, or a list
+                     for multiclass).  ``?raw_score=1`` skips the
+                     objective's output conversion.
+  GET  /healthz      liveness: ``{"status": "ok"}``
+  GET  /stats        serving metrics: batcher counters + latency
+                     quantiles, bucket-cache compile accounting, queue
+                     depth, uptime.
+
+Each HTTP request becomes one ``MicroBatcher.submit`` call, so
+concurrent requests coalesce into shared device batches; an overloaded
+queue answers 503 and an expired request deadline 504 (shed-not-queue,
+see batcher.py).
+
+Startup: ``model=`` accepts either a packed ``.npz`` artifact
+(serve/artifact.py) or a reference-format model text file, which is
+packed on the fly.  Unless ``warmup=0``, the bucket ladder is
+precompiled before the socket starts accepting, so the first real
+request never pays an XLA compile.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..obs import compilewatch, tracer
+from ..utils.log import Log
+from .artifact import PackedPredictor, PredictorArtifact
+from .batcher import MicroBatcher, RequestTimeout, ServerOverloaded
+
+DEFAULTS = {
+    "port": 9090,
+    "max_batch_size": 1024,
+    "max_delay_ms": 2.0,
+    "max_queue_rows": 8192,
+    "request_timeout_ms": 2000,
+    "warmup": 1,
+    "warmup_max_rows": 4096,
+    "shard": 0,
+}
+
+
+def load_predictor(model_path: str, shard: bool = False) -> PackedPredictor:
+    """Load a packed ``.npz`` artifact, or pack a model text file."""
+    if model_path.endswith(".npz"):
+        artifact = PredictorArtifact.load(model_path)
+    else:
+        from ..basic import Booster
+
+        artifact = PredictorArtifact.from_booster(Booster(model_file=model_path))
+    predictor = PackedPredictor(artifact)
+    if shard:
+        from .compilecache import BucketedRawPredictor
+
+        predictor.raw = BucketedRawPredictor.from_tree_arrays(
+            artifact.arrays, artifact.num_tree_per_iteration, shard=True
+        )
+    return predictor
+
+
+def _parse_rows(body: bytes) -> np.ndarray:
+    rows: List[List[float]] = []
+    width = None
+    for ln, line in enumerate(body.decode("utf-8").splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        row = json.loads(line)
+        if isinstance(row, dict):
+            row = row.get("features")
+        if not isinstance(row, list):
+            raise ValueError(f"line {ln + 1}: expected a JSON array of features")
+        if width is None:
+            width = len(row)
+        elif len(row) != width:
+            raise ValueError(
+                f"line {ln + 1}: ragged request ({len(row)} features, "
+                f"expected {width})"
+            )
+        rows.append([float(v) for v in row])
+    if not rows:
+        raise ValueError("empty request body")
+    return np.asarray(rows, np.float64)
+
+
+class PredictServer(ThreadingHTTPServer):
+    """HTTP server owning the predictor + batcher; ``daemon_threads`` so
+    in-flight handler threads never block shutdown."""
+
+    daemon_threads = True
+
+    def __init__(self, addr, predictor: PackedPredictor,
+                 batcher_opts: Optional[Dict] = None):
+        self.predictor = predictor
+        opts = dict(batcher_opts or {})
+        self.batcher = MicroBatcher(
+            lambda batch: predictor.predict(batch),
+            **opts,
+        )
+        self.raw_batcher = MicroBatcher(
+            lambda batch: predictor.predict(batch, raw_score=True),
+            **opts,
+        )
+        self.t_start = time.time()
+        super().__init__(addr, _Handler)
+
+    def stats(self) -> Dict:
+        cw = compilewatch.snapshot()
+        watched = cw["watched"].get("serve.predict_raw", {})
+        return {
+            "uptime_s": round(time.time() - self.t_start, 1),
+            "num_features": self.predictor.num_features,
+            "num_class": self.predictor.artifact.num_class,
+            "batcher": self.batcher.stats(),
+            "raw_batcher": self.raw_batcher.stats(),
+            "compiles": {
+                "backend_compiles": cw["backend_compiles"],
+                "predict_calls": watched.get("calls", 0),
+                "predict_compiles": watched.get("compiles", 0),
+                "predict_retraces": watched.get("retraces", 0),
+            },
+        }
+
+    def shutdown(self):
+        super().shutdown()
+        self.batcher.close()
+        self.raw_batcher.close()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "lightgbm-tpu-serve/1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # route access logs to our logger
+        Log.debug("serve: " + fmt, *args)
+
+    def _reply(self, code: int, payload: bytes,
+               ctype: str = "application/json") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _reply_json(self, code: int, obj) -> None:
+        self._reply(code, (json.dumps(obj) + "\n").encode())
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._reply_json(200, {"status": "ok"})
+        elif self.path == "/stats":
+            self._reply_json(200, self.server.stats())
+        else:
+            self._reply_json(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):
+        path, _, query = self.path.partition("?")
+        if path != "/predict":
+            self._reply_json(404, {"error": f"unknown path {path}"})
+            return
+        raw_score = "raw_score=1" in query
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            rows = _parse_rows(self.rfile.read(length))
+        except (ValueError, json.JSONDecodeError) as e:
+            self._reply_json(400, {"error": str(e)})
+            return
+        batcher = self.server.raw_batcher if raw_score else self.server.batcher
+        try:
+            preds = batcher.submit(rows)
+        except ServerOverloaded as e:
+            self._reply_json(503, {"error": str(e)})
+            return
+        except RequestTimeout as e:
+            self._reply_json(504, {"error": str(e)})
+            return
+        except Exception as e:
+            Log.warning("serve: predict failed: %s", e)
+            self._reply_json(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        lines = [json.dumps(p.tolist() if isinstance(p, np.ndarray) else float(p))
+                 for p in preds]
+        self._reply(200, ("\n".join(lines) + "\n").encode(),
+                    ctype="application/jsonl")
+
+
+def make_server(model_path: str, host: str = "127.0.0.1", port: int = 0,
+                warmup_max_rows: int = 4096, shard: bool = False,
+                do_warmup: bool = True, **batcher_opts) -> PredictServer:
+    """Build (and optionally warm) a ready-to-run server; ``port=0``
+    binds an ephemeral port (tests)."""
+    predictor = load_predictor(model_path, shard=shard)
+    if do_warmup:
+        stats = predictor.warmup(warmup_max_rows)
+        Log.info("serve: warmup compiled %d programs over buckets %s in %.2fs",
+                 stats["compiles"], stats["buckets"], stats["secs"])
+    return PredictServer((host, port), predictor, batcher_opts)
+
+
+def main(argv: List[str]) -> int:
+    """``python -m lightgbm_tpu serve model=... [key=value ...]``."""
+    from ..cli import parse_argv
+
+    tracer.refresh_from_env()
+    params = parse_argv(argv)
+    model_path = params.get("model") or params.get("input_model")
+    if not model_path:
+        Log.warning("serve: no model file (model=path.npz or model=model.txt)")
+        return 1
+    opts = dict(DEFAULTS)
+    for k in list(opts):
+        if k in params:
+            opts[k] = type(opts[k])(float(params[k]))
+    server = make_server(
+        model_path,
+        host=str(params.get("host", "127.0.0.1")),
+        port=int(opts["port"]),
+        warmup_max_rows=int(opts["warmup_max_rows"]),
+        shard=bool(opts["shard"]),
+        do_warmup=bool(opts["warmup"]),
+        max_batch_size=int(opts["max_batch_size"]),
+        max_delay_ms=float(opts["max_delay_ms"]),
+        max_queue_rows=int(opts["max_queue_rows"]),
+        request_timeout_ms=float(opts["request_timeout_ms"]),
+    )
+    host, port = server.server_address[:2]
+    Log.info("serve: listening on http://%s:%d (POST /predict, GET /stats)",
+             host, port)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        Log.info("serve: shutting down")
+    finally:
+        server.shutdown()
+        server.server_close()
+    return 0

@@ -1,0 +1,160 @@
+"""Prediction early-stop tests vs the reference semantics
+(src/boosting/prediction_early_stop.cpp:74-89 + the Predictor's
+round-period wiring): the margin callback fires only every
+``round_period`` iterations, binary margin is ``2*|pred|``, multiclass
+margin is the top-2 gap, and "none" never stops.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.boosting.pred_early_stop import (
+    create_prediction_early_stop_instance,
+    predict_with_early_stop,
+)
+from lightgbm_tpu.model.tree import Tree
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+class _FakeBoosting:
+    """Minimal boosting stub: constant trees, so each iteration adds a
+    known value per class and the stop point is computable by hand."""
+
+    def __init__(self, values, k):
+        # values: flat per-tree outputs, tree i belongs to class i % k
+        self.models = [Tree.constant(v) for v in values]
+        self.num_tree_per_iteration = k
+
+    def _used_models(self, num_iteration=-1):
+        if num_iteration > 0:
+            return self.models[: num_iteration * self.num_tree_per_iteration]
+        return self.models
+
+
+ROW = np.zeros((1, 3))
+
+
+class TestCallbacks:
+    def test_binary_margin_formula(self):
+        inst = create_prediction_early_stop_instance("binary", 1, 1.0)
+        assert inst.round_period == 1
+        assert not inst.callback(np.array([0.5]))   # 2*0.5 == margin, not >
+        assert inst.callback(np.array([0.51]))
+        assert inst.callback(np.array([-0.51]))     # absolute value
+
+    def test_binary_requires_single_output(self):
+        inst = create_prediction_early_stop_instance("binary", 1, 1.0)
+        with pytest.raises(LightGBMError, match="length one"):
+            inst.callback(np.array([0.1, 0.2]))
+
+    def test_multiclass_top2_gap(self):
+        inst = create_prediction_early_stop_instance("multiclass", 1, 1.0)
+        assert not inst.callback(np.array([2.0, 1.5, 0.0]))  # gap 0.5
+        assert inst.callback(np.array([2.6, 1.5, 0.0]))      # gap 1.1
+
+    def test_multiclass_requires_two_outputs(self):
+        inst = create_prediction_early_stop_instance("multiclass", 1, 1.0)
+        with pytest.raises(LightGBMError, match="length two"):
+            inst.callback(np.array([0.1]))
+
+    def test_none_never_stops(self):
+        inst = create_prediction_early_stop_instance("none")
+        assert inst.round_period == 1 << 30
+        assert not inst.callback(np.array([1e9]))
+
+    def test_unknown_type_fatal(self):
+        with pytest.raises(LightGBMError, match="Unknown early stopping"):
+            create_prediction_early_stop_instance("bogus")
+
+
+class TestRoundPeriod:
+    def test_binary_stops_at_first_checked_round(self):
+        # each iteration adds 0.3; margin 1.0 is crossed at iter 2
+        # (2*0.6 > 1.0), and period=2 checks iter 2 -> stop with 0.6
+        b = _FakeBoosting([0.3] * 6, k=1)
+        inst = create_prediction_early_stop_instance("binary", 2, 1.0)
+        out = predict_with_early_stop(b, ROW, inst)
+        assert out.shape == (1, 1)
+        assert np.isclose(out[0, 0], 0.6)
+
+    def test_binary_round_period_delays_stop(self):
+        # same trees, but period=4: the margin is crossed at iter 2 and
+        # NOT checked until iter 4 -> 4 iterations accumulate (0.3*4)
+        b = _FakeBoosting([0.3] * 6, k=1)
+        inst = create_prediction_early_stop_instance("binary", 4, 1.0)
+        out = predict_with_early_stop(b, ROW, inst)
+        assert np.isclose(out[0, 0], 1.2)
+
+    def test_binary_huge_margin_runs_all_trees(self):
+        b = _FakeBoosting([0.3] * 6, k=1)
+        inst = create_prediction_early_stop_instance("binary", 1, 1e9)
+        out = predict_with_early_stop(b, ROW, inst)
+        assert np.isclose(out[0, 0], 1.8)
+
+    def test_multiclass_stops_on_top2_gap(self):
+        # class 0 gains 0.5/iter, class 1 gains 0.1/iter: gap 0.4*i
+        # crosses margin 1.0 at iter 3; period=1 stops there
+        b = _FakeBoosting([0.5, 0.1] * 5, k=2)
+        inst = create_prediction_early_stop_instance("multiclass", 1, 1.0)
+        out = predict_with_early_stop(b, ROW, inst)
+        assert np.allclose(out[0], [1.5, 0.3])
+
+    def test_multiclass_round_period(self):
+        # period=2 checks iters 2 (gap 0.8, no) and 4 (gap 1.6, stop)
+        b = _FakeBoosting([0.5, 0.1] * 5, k=2)
+        inst = create_prediction_early_stop_instance("multiclass", 2, 1.0)
+        out = predict_with_early_stop(b, ROW, inst)
+        assert np.allclose(out[0], [2.0, 0.4])
+
+    def test_per_row_independence(self):
+        # rows stop independently: a constant-tree model gives every row
+        # the same trajectory, so both rows stop at the same point
+        b = _FakeBoosting([0.3] * 6, k=1)
+        inst = create_prediction_early_stop_instance("binary", 2, 1.0)
+        out = predict_with_early_stop(b, np.zeros((2, 3)), inst)
+        assert np.allclose(out[:, 0], 0.6)
+
+
+class TestBoosterIntegration:
+    def test_pred_early_stop_param_matches_full_predict(self):
+        """With a huge margin the early-stop path runs every tree; its
+        host-side f64 walk must agree with the device predict to float
+        tolerance (the device sums leaf values in f32)."""
+        rng = np.random.RandomState(9)
+        X = rng.randn(120, 6)
+        y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+        ds = lgb.Dataset(X, label=y, params={"min_data_in_leaf": 5})
+        bst = lgb.train(
+            {"objective": "binary", "num_leaves": 7, "verbose": -1,
+             "pred_early_stop": True, "pred_early_stop_freq": 5,
+             "pred_early_stop_margin": 1e15},
+            ds, num_boost_round=8, verbose_eval=False,
+        )
+        es = bst.predict(X[:25], raw_score=True)
+        full = bst.boosting._predict_raw_scores_unbucketed(
+            np.asarray(X[:25], np.float64),
+            bst.boosting._used_models(-1),
+            bst.boosting.num_tree_per_iteration,
+        )[0]
+        assert np.allclose(es, full, rtol=1e-5, atol=1e-6)
+
+    def test_pred_early_stop_small_margin_diverges(self):
+        """A small margin must actually exit early (different raw scores
+        than the full walk for at least some rows)."""
+        rng = np.random.RandomState(9)
+        X = rng.randn(120, 6)
+        y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+        ds = lgb.Dataset(X, label=y, params={"min_data_in_leaf": 5})
+        params = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+                  "pred_early_stop": True, "pred_early_stop_freq": 1,
+                  "pred_early_stop_margin": 0.01}
+        bst = lgb.train(dict(params), ds, num_boost_round=20,
+                        verbose_eval=False)
+        es = bst.predict(X[:40], raw_score=True)
+        full = bst.boosting._predict_raw_scores_unbucketed(
+            np.asarray(X[:40], np.float64),
+            bst.boosting._used_models(-1),
+            bst.boosting.num_tree_per_iteration,
+        )[0]
+        assert not np.allclose(es, full)

@@ -1,0 +1,405 @@
+"""Serving subsystem tests: packed artifact save/load/predict parity,
+the shape-bucketed compile cache (the acceptance contract: a warmed
+predictor answers mixed-size batches with ZERO new compiles and
+bit-identical outputs vs Booster.predict), the microbatcher
+(coalescing, overload shedding, timeouts), and the HTTP front end.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import compilewatch
+from lightgbm_tpu.ops.predict import TreeArrays
+from lightgbm_tpu.serve import (
+    BucketedRawPredictor,
+    MicroBatcher,
+    PackedPredictor,
+    PredictorArtifact,
+    RequestTimeout,
+    ServerOverloaded,
+    bucket_for,
+    bucket_ladder,
+)
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+@pytest.fixture(scope="module")
+def binary_booster():
+    rng = np.random.RandomState(3)
+    X = rng.randn(600, 12)
+    y = (X[:, 0] + 0.5 * X[:, 1] - X[:, 2] ** 2 > -0.5).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, params={"min_data_in_leaf": 5})
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 15, "verbose": -1},
+        ds, num_boost_round=12, verbose_eval=False,
+    )
+    return bst, X
+
+
+@pytest.fixture(scope="module")
+def multiclass_booster():
+    rng = np.random.RandomState(4)
+    X = rng.randn(400, 8)
+    y = (np.abs(X[:, 0]) + X[:, 1] > 1).astype(np.float32) + (X[:, 0] > 0)
+    ds = lgb.Dataset(X, label=y, params={"min_data_in_leaf": 5})
+    bst = lgb.train(
+        {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+         "verbose": -1},
+        ds, num_boost_round=6, verbose_eval=False,
+    )
+    return bst, X
+
+
+class TestBuckets:
+    def test_bucket_for(self):
+        assert bucket_for(1) == 8
+        assert bucket_for(8) == 8
+        assert bucket_for(9) == 16
+        assert bucket_for(3000) == 4096
+        assert bucket_for(1, min_bucket=4) == 4
+
+    def test_bucket_multiple_of_devices(self):
+        # a 12-device host: buckets stay divisible by the device count
+        assert bucket_for(9, multiple_of=12) % 12 == 0
+
+    def test_ladder_covers_every_size(self):
+        ladder = bucket_ladder(4096)
+        assert ladder == [8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096]
+        for n in (1, 7, 100, 3000, 4096):
+            assert bucket_for(n) in ladder
+
+
+class TestTreeArraysValidate:
+    def _arrays(self, t=3, m=5, L=6):
+        kw = {f: np.zeros((t, m), np.int32) for f in TreeArrays.FIELDS}
+        kw["leaf_value"] = np.zeros((t, L), np.float32)
+        return kw
+
+    def test_ok(self):
+        TreeArrays(**self._arrays()).validate()
+
+    def test_mismatched_node_plane(self):
+        kw = self._arrays()
+        kw["threshold_bin"] = np.zeros((3, 4), np.int32)
+        with pytest.raises(ValueError, match="threshold_bin"):
+            TreeArrays(**kw).validate()
+
+    def test_mismatched_leaf_tree_count(self):
+        kw = self._arrays()
+        kw["leaf_value"] = np.zeros((2, 6), np.float32)
+        with pytest.raises(ValueError, match="leaf_value"):
+            TreeArrays(**kw).validate()
+
+    def test_non_2d(self):
+        kw = self._arrays()
+        kw["zero_bin"] = np.zeros((3,), np.int32)
+        with pytest.raises(ValueError, match="zero_bin"):
+            TreeArrays(**kw).validate()
+
+
+class TestArtifact:
+    def test_save_load_predict_parity(self, binary_booster, tmp_path):
+        bst, X = binary_booster
+        art = PredictorArtifact.from_booster(bst)
+        path = art.save(str(tmp_path / "model"))
+        assert path.endswith(".npz")
+        loaded = PredictorArtifact.load(path)
+        assert loaded.meta == art.meta
+        packed = PackedPredictor(loaded)
+        for n in (1, 33, 600):
+            assert np.array_equal(packed.predict(X[:n]), bst.predict(X[:n]))
+        # raw scores too
+        assert np.array_equal(
+            packed.predict(X[:50], raw_score=True),
+            bst.predict(X[:50], raw_score=True),
+        )
+
+    def test_multiclass_parity(self, multiclass_booster, tmp_path):
+        bst, X = multiclass_booster
+        path = PredictorArtifact.from_booster(bst).save(str(tmp_path / "mc"))
+        packed = PackedPredictor(PredictorArtifact.load(path))
+        got, exp = packed.predict(X[:40]), bst.predict(X[:40])
+        assert got.shape == (40, 3)
+        assert np.array_equal(got, exp)
+
+    def test_metadata(self, binary_booster):
+        bst, _ = binary_booster
+        art = PredictorArtifact.from_booster(bst)
+        assert art.num_class == 1
+        assert art.num_tree_per_iteration == 1
+        assert art.num_features == 12
+        assert art.meta["objective"].startswith("binary")
+
+    def test_load_rejects_non_artifact(self, tmp_path):
+        p = str(tmp_path / "junk.npz")
+        np.savez(p, foo=np.zeros(3))
+        with pytest.raises(LightGBMError, match="__meta__"):
+            PredictorArtifact.load(p)
+
+    def test_load_rejects_future_version(self, binary_booster, tmp_path):
+        bst, _ = binary_booster
+        art = PredictorArtifact.from_booster(bst)
+        art.meta["format_version"] = 999
+        # bypass validate-on-init by writing directly
+        import json as _json
+
+        payload = {f: getattr(art.arrays, f) for f in TreeArrays.FIELDS}
+        payload["__meta__"] = np.asarray(_json.dumps(art.meta))
+        p = str(tmp_path / "future.npz")
+        np.savez(p, **payload)
+        with pytest.raises(LightGBMError, match="format_version"):
+            PredictorArtifact.load(p)
+
+    def test_num_iteration_subset(self, binary_booster, tmp_path):
+        bst, X = binary_booster
+        art = PredictorArtifact.from_booster(bst, num_iteration=5)
+        packed = PackedPredictor(art)
+        assert np.array_equal(
+            packed.predict(X[:30]), bst.predict(X[:30], num_iteration=5)
+        )
+
+
+class TestCompileCache:
+    def test_warmed_mixed_sizes_zero_compiles_bit_identical(
+            self, binary_booster, monkeypatch):
+        """The PR acceptance criterion: after warmup(), mixed-size
+        requests (N in {1, 7, 100, 3000}) trigger ZERO new compiles
+        (obs compile accountant) and results are bit-identical to
+        Booster.predict on the same rows.  The expected values come from
+        the exact-shape legacy path so they cannot incidentally pre-warm
+        the bucket-shaped programs being asserted on."""
+        bst, X = binary_booster
+        big = np.tile(X, (6, 1))[:3000]  # 3000 rows from the same rows
+        monkeypatch.setenv("LIGHTGBM_TPU_PREDICT_BUCKETS", "0")
+        expected = {n: bst.predict(big[:n]) for n in (1, 7, 100, 3000)}
+        monkeypatch.delenv("LIGHTGBM_TPU_PREDICT_BUCKETS")
+        packed = PackedPredictor(PredictorArtifact.from_booster(bst))
+        stats = packed.warmup(4096)
+        assert stats["buckets"][-1] >= 3000
+        c0 = compilewatch.total_compiles()
+        for n in (1, 7, 100, 3000):
+            got = packed.predict(big[:n])
+            assert got.shape == (n,)
+            assert np.array_equal(got, expected[n]), f"N={n} not bit-identical"
+        assert compilewatch.total_compiles() - c0 == 0, \
+            "warmed predictor recompiled on a covered batch size"
+
+    def test_booster_predict_uses_buckets(self, binary_booster, monkeypatch):
+        """Repeated Booster.predict at varying N reuses the bucket
+        programs: after touching a bucket once, more sizes inside it
+        compile nothing new."""
+        bst, X = binary_booster
+        bst.predict(X[:40])  # compiles the 64-bucket
+        c0 = compilewatch.total_compiles()
+        for n in (33, 50, 64, 41):  # all inside the same 64-bucket
+            bst.predict(X[:n])
+        assert compilewatch.total_compiles() - c0 == 0
+
+    def test_bucketed_matches_legacy_path(self, binary_booster, monkeypatch):
+        bst, X = binary_booster
+        bucketed = bst.predict(X[:77])
+        monkeypatch.setenv("LIGHTGBM_TPU_PREDICT_BUCKETS", "0")
+        legacy = bst.predict(X[:77])
+        assert np.array_equal(bucketed, legacy)
+
+    def test_sharded_predict_matches(self, binary_booster):
+        """Row-sharded traversal over the 8-device CPU mesh returns the
+        same bits as the single-device path."""
+        import jax
+
+        if len(jax.local_devices()) < 2:
+            pytest.skip("needs >1 local device")
+        bst, X = binary_booster
+        b = bst.boosting
+        sharded = BucketedRawPredictor.from_models(
+            b._used_models(-1), b.num_tree_per_iteration, shard=True
+        )
+        got = sharded.predict_raw_scores(np.asarray(X[:100], np.float64))
+        exp = b.predict_raw_scores(np.asarray(X[:100], np.float64))
+        assert np.array_equal(got, exp)
+
+    def test_model_invalidation(self, tmp_path):
+        """Training more iterations invalidates the booster's cached
+        bucketed predictor (keyed on tree count)."""
+        rng = np.random.RandomState(5)
+        X = rng.randn(200, 5)
+        y = (X[:, 0] > 0).astype(np.float32)
+        ds = lgb.Dataset(X, label=y, params={"min_data_in_leaf": 5})
+        bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+                        ds, num_boost_round=3, verbose_eval=False)
+        p3 = bst.predict(X[:20])
+        bst.update()
+        p4 = bst.predict(X[:20])
+        assert not np.array_equal(p3, p4)
+
+
+class TestMicroBatcher:
+    def test_coalesces_concurrent_requests(self, binary_booster):
+        bst, X = binary_booster
+        packed = PackedPredictor(PredictorArtifact.from_booster(bst))
+        packed.warmup(256)
+        mb = MicroBatcher(packed.predict, max_batch_size=128, max_delay_ms=20)
+        try:
+            import concurrent.futures as cf
+
+            with cf.ThreadPoolExecutor(16) as ex:
+                futs = [ex.submit(mb.submit, X[i * 4:(i + 1) * 4])
+                        for i in range(16)]
+                outs = [f.result() for f in futs]
+            exp = bst.predict(X[:64])
+            for i, o in enumerate(outs):
+                assert np.array_equal(o, exp[i * 4:(i + 1) * 4])
+            st = mb.stats()
+            assert st["requests"] == 16 and st["rows"] == 64
+            assert st["batches"] < 16, "no coalescing happened"
+            assert st["latency_p99_ms"] > 0
+        finally:
+            mb.close()
+
+    def test_overload_shedding(self):
+        release = threading.Event()
+
+        def slow_predict(batch):
+            release.wait(5.0)
+            return np.zeros(batch.shape[0])
+
+        mb = MicroBatcher(slow_predict, max_batch_size=4, max_delay_ms=1,
+                          max_queue_rows=8)
+        try:
+            t = threading.Thread(
+                target=lambda: mb.submit(np.zeros((8, 3)), timeout_ms=10_000),
+                daemon=True)
+            t.start()
+            # wait until the first request is in flight or queued
+            import time as _t
+
+            _t.sleep(0.2)
+            with pytest.raises(ServerOverloaded):
+                mb.submit(np.zeros((9, 3)))
+            assert mb.stats()["shed"] == 1
+        finally:
+            release.set()
+            mb.close()
+
+    def test_queued_request_timeout(self):
+        release = threading.Event()
+
+        def slow_predict(batch):
+            release.wait(5.0)
+            return np.zeros(batch.shape[0])
+
+        mb = MicroBatcher(slow_predict, max_batch_size=2, max_delay_ms=1)
+        try:
+            t = threading.Thread(
+                target=lambda: mb.submit(np.zeros((2, 3)), timeout_ms=10_000),
+                daemon=True)
+            t.start()
+            with pytest.raises(RequestTimeout):
+                mb.submit(np.zeros((2, 3)), timeout_ms=50)
+            assert mb.stats()["timeouts"] == 1
+        finally:
+            release.set()
+            mb.close()
+
+    def test_predict_error_propagates(self):
+        def bad_predict(batch):
+            raise ValueError("boom")
+
+        mb = MicroBatcher(bad_predict, max_delay_ms=1)
+        try:
+            with pytest.raises(ValueError, match="boom"):
+                mb.submit(np.zeros((2, 3)))
+            assert mb.stats()["errors"] == 1
+        finally:
+            mb.close()
+
+
+class TestHTTPServer:
+    @pytest.fixture()
+    def server(self, binary_booster, tmp_path):
+        from lightgbm_tpu.serve.server import make_server
+
+        bst, X = binary_booster
+        path = PredictorArtifact.from_booster(bst).save(str(tmp_path / "m"))
+        srv = make_server(path, port=0, warmup_max_rows=256, max_delay_ms=1.0)
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        yield srv, bst, X
+        srv.shutdown()
+        srv.server_close()
+
+    def _post(self, port, rows, query=""):
+        body = "\n".join(json.dumps(list(map(float, r))) for r in rows).encode()
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/predict{query}", data=body, timeout=30)
+        return [json.loads(l) for l in r.read().decode().splitlines()]
+
+    def test_predict_matches_booster(self, server):
+        srv, bst, X = server
+        port = srv.server_address[1]
+        preds = self._post(port, X[:9])
+        assert np.array_equal(np.asarray(preds), bst.predict(X[:9]))
+
+    def test_raw_score_and_dict_rows(self, server):
+        srv, bst, X = server
+        port = srv.server_address[1]
+        body = "\n".join(
+            json.dumps({"features": list(map(float, r))}) for r in X[:3]
+        ).encode()
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/predict?raw_score=1", data=body, timeout=30)
+        raw = [json.loads(l) for l in r.read().decode().splitlines()]
+        assert np.array_equal(np.asarray(raw), bst.predict(X[:3], raw_score=True))
+
+    def test_health_and_stats(self, server):
+        srv, bst, X = server
+        port = srv.server_address[1]
+        self._post(port, X[:5])
+        h = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=30).read())
+        assert h == {"status": "ok"}
+        st = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats", timeout=30).read())
+        assert st["num_features"] == 12
+        assert st["batcher"]["requests"] >= 1
+        assert st["compiles"]["predict_retraces"] == 0
+
+    def test_bad_requests(self, server):
+        srv, _, _ = server
+        port = srv.server_address[1]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/predict",
+                                   data=b"[1,2]\n[1]\n", timeout=30)
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/predict",
+                                   data=b"", timeout=30)
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope",
+                                   data=b"[1]\n", timeout=30)
+        assert ei.value.code == 404
+
+    def test_server_accepts_model_text_file(self, binary_booster, tmp_path):
+        """model= also accepts the reference-format text file (packed on
+        the fly)."""
+        from lightgbm_tpu.serve.server import load_predictor
+
+        bst, X = binary_booster
+        path = str(tmp_path / "model.txt")
+        bst.save_model(path)
+        packed = load_predictor(path)
+        assert np.array_equal(packed.predict(X[:5]), bst.predict(X[:5]))
+
+
+class TestCLI:
+    def test_serve_without_model_errors(self, capsys):
+        from lightgbm_tpu.cli import main
+
+        assert main(["serve"]) == 1
