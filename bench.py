@@ -105,6 +105,81 @@ def _bench_serving(booster, X, batch_sizes=(1, 128, 2048), reps=20):
     return section
 
 
+def _bench_ingest(X, y, n_rows):
+    """Streaming-ingest benchmark (docs/DATA.md): write the bench matrix
+    as CSV, stream it through the two-pass out-of-core pipeline, and
+    report rows/s, chunk count and the peak-RSS bound that proves the
+    raw float matrix was never materialized (the acceptance contract:
+    peak RSS - start RSS < packed matrix + O(chunk), asserted via the
+    obs memory gauges that data/ingest.py records).  BENCH_INGEST=0
+    skips, BENCH_INGEST_ROWS caps the row count."""
+    import tempfile
+
+    from lightgbm_tpu.basic import Dataset
+
+    section = {}
+    rows = min(int(os.environ.get("BENCH_INGEST_ROWS", n_rows)), len(X))
+    path = os.path.join(
+        os.environ.get("BENCH_INGEST_DIR", tempfile.gettempdir()),
+        f"bench_ingest_{rows}.csv",
+    )
+    try:
+        t0 = time.time()
+        import pandas as pd
+
+        pd.DataFrame(np.column_stack([y[:rows], X[:rows]])).to_csv(
+            path, index=False, header=False, float_format="%.7g"
+        )
+        section["write_csv_s"] = round(time.time() - t0, 2)
+        section["csv_mb"] = round(os.path.getsize(path) / 1e6, 1)
+
+        env_before = os.environ.get("LIGHTGBM_TPU_STREAM_INGEST")
+        os.environ["LIGHTGBM_TPU_STREAM_INGEST"] = "1"
+        try:
+            t0 = time.time()
+            ds = Dataset(path).construct()
+            ingest_s = time.time() - t0
+        finally:
+            if env_before is None:
+                os.environ.pop("LIGHTGBM_TPU_STREAM_INGEST", None)
+            else:
+                os.environ["LIGHTGBM_TPU_STREAM_INGEST"] = env_before
+        rep = dict(getattr(ds, "ingest_report", {}))
+        section.update({
+            "rows": rows,
+            "ingest_s": round(ingest_s, 2),
+            "rows_per_s": round(rows / max(ingest_s, 1e-9), 1),
+            "chunks": rep.get("chunks_pass2"),
+            "chunk_rows": rep.get("chunk_rows"),
+            "packed_mb": rep.get("packed_mb"),
+            "rss_start_mb": rep.get("rss_start_mb"),
+            "rss_peak_mb": rep.get("rss_peak_mb"),
+            "sketch": rep.get("sketch"),
+        })
+        # the bound: packed matrix + a few in-flight chunk buffers
+        # (parser scratch included) + fixed slack.  The raw float64
+        # matrix would be rows*cols*8 bytes — reported alongside so the
+        # separation is visible at a glance.
+        chunk_raw_mb = (rep.get("chunk_rows", 0) * (X.shape[1] + 1) * 8) / 1e6
+        bound_mb = (rep.get("packed_mb", 0.0) or 0.0) + 8 * chunk_raw_mb + 128
+        increase = (rep.get("rss_peak_mb", 0.0) or 0.0) - (
+            rep.get("rss_start_mb", 0.0) or 0.0
+        )
+        section["raw_matrix_mb"] = round(rows * (X.shape[1] + 1) * 8 / 1e6, 1)
+        section["rss_increase_mb"] = round(increase, 1)
+        section["rss_bound_mb"] = round(bound_mb, 1)
+        section["rss_bound_ok"] = bool(increase <= bound_mb)
+    except Exception as e:  # pragma: no cover — ingest must not kill bench
+        section["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        if os.environ.get("BENCH_INGEST_KEEP", "0") != "1":
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    return section
+
+
 def _auc(y, s):
     """AUC via the library's own metric (one implementation to trust)."""
     from lightgbm_tpu.config import Config
@@ -389,6 +464,12 @@ def main():
     # new compiles (the serving acceptance contract).
     if os.environ.get("BENCH_SERVING", "1") != "0":
         out["serving"] = _bench_serving(booster, X)
+
+    # streaming-ingest section (docs/DATA.md): rows/s + the peak-RSS
+    # bound proving the raw float matrix never materialized.  At
+    # BENCH_ROWS=10500000 this is the Higgs-scale ingest entry.
+    if os.environ.get("BENCH_INGEST", "1") != "0":
+        out["ingest"] = _bench_ingest(X, y, n_rows)
 
     # run-trace embedding (docs/OBSERVABILITY.md): the per-phase span
     # totals and compile accounting gathered during THIS run, so the

@@ -169,6 +169,31 @@ class Dataset:
                     ds.metadata.set_init_score(self.init_score)
                 self._constructed = ds
                 return ds
+            from .data.ingest import should_stream, stream_dataset
+
+            if should_stream(self.data_path, cfg):
+                # out-of-core path (data/ingest.py): two-pass chunked
+                # construction, bit-identical mappers/bins to the
+                # in-memory load of the same file — the raw float matrix
+                # is never materialized, so self.data stays None
+                ref = self.reference.construct() if self.reference is not None else None
+                ds = stream_dataset(
+                    self.data_path, cfg,
+                    feature_name=self.feature_name,
+                    categorical_feature=self.categorical_feature,
+                    reference=ref,
+                )
+                if self.label is not None:
+                    ds.metadata.set_label(self.label)
+                if self.weight is not None:
+                    ds.metadata.set_weights(self.weight)
+                if self.group is not None:
+                    ds.metadata.set_query(self.group)
+                if self.init_score is not None:
+                    ds.metadata.set_init_score(self.init_score)
+                self.label_idx = ds.label_idx
+                self._constructed = ds
+                return ds
             from .io.parser import load_text_file
 
             feats, label, weights, group, names, label_idx = load_text_file(

@@ -1147,10 +1147,20 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
                         other_c = jnp.maximum(jnp.floor(other_rate * nreal), 1.0)
                         goss_mult = (nreal - top_c) / other_c
                         goss_prob = other_c / jnp.maximum(nreal - top_c, 1.0)
-                        top_vals, _ = jax.lax.top_k(gscore, top_cnt_max)
-                        kth = jnp.clip(top_c.astype(jnp.int32), 1, top_cnt_max) - 1
-                        thr_v = top_vals[kth]
-                        is_top = (gscore >= thr_v) & (gscore > 0)
+                        # exactly top_c rows marked top via the top_k
+                        # INDICES (ADVICE r5: a >= threshold test admits
+                        # every tie — common with integer features — and
+                        # can never admit zero-gradient rows, so the
+                        # nominal-count goss_mult was biased).  Padding
+                        # rows are pushed below every valid row so ties
+                        # at zero resolve to real rows first.
+                        topc_i = jnp.clip(top_c.astype(jnp.int32), 1, top_cnt_max)
+                        _, top_idx = jax.lax.top_k(
+                            jnp.where(valid > 0, gscore, -1.0), top_cnt_max
+                        )
+                        rank_ok = jnp.arange(top_cnt_max) < topc_i
+                        is_top = (jnp.zeros((nl,), bool).at[top_idx].set(rank_ok)
+                                  & (valid > 0))
                         gkey = jax.random.fold_in(
                             jax.random.fold_in(jax.random.fold_in(key, 2), it), ax
                         )

@@ -106,6 +106,28 @@ def run_train(config: Config, params: Dict[str, str]) -> None:
     Log.info("Finished training, model saved to %s", config.output_model)
 
 
+def run_ingest(config: Config, params: Dict[str, str]) -> None:
+    """task=ingest (TPU extension): stream a text file through the
+    out-of-core pipeline (data/ingest.py) into the binary dataset cache
+    ``<data>.bin`` — the raw float matrix is never materialized, so
+    arbitrarily large files prep on a bounded-memory host.  Training
+    then loads the cache (DatasetLoader::LoadFromBinFile path)."""
+    import json
+
+    from .data.ingest import stream_dataset
+    from .obs import tracer
+
+    if not config.data:
+        Log.fatal("No data for ingest, application quit")
+    tracer.refresh_from_env()
+    ds = stream_dataset(config.data, config)
+    out = config.data + ".bin"
+    ds.save_binary(out)
+    report = dict(getattr(ds, "ingest_report", {}))
+    report["output"] = out
+    Log.info("Finished ingest: %s", json.dumps(report))
+
+
 def run_convert_model(config: Config, params: Dict[str, str]) -> None:
     """task=convert_model (application.cpp:268-273): emit the standalone
     C++ if-else predictor (convert_model.py <- GBDT::ModelToIfElse)."""
@@ -166,6 +188,9 @@ def main(argv: List[str] = None) -> int:
         from .serve.server import main as serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "ingest":
+        # subcommand sugar for task=ingest (matches report/serve style)
+        argv = ["task=ingest"] + argv[1:]
     try:
         params = load_all_params(argv)
         config = Config.from_params(params)
@@ -175,6 +200,8 @@ def main(argv: List[str] = None) -> int:
             run_predict(config, params)
         elif config.task == "convert_model":
             run_convert_model(config, params)
+        elif config.task == "ingest":
+            run_ingest(config, params)
         else:
             Log.fatal("Unknown task type %s", config.task)
     except Exception as ex:  # main.cpp catches and exits non-zero

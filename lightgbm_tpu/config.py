@@ -153,6 +153,12 @@ class Config:
     pred_early_stop: bool = False
     pred_early_stop_freq: int = 10
     pred_early_stop_margin: float = 10.0
+    # --- streaming ingest (data/ingest.py; TPU-specific extension).
+    # stream_ingest: 'auto' streams text loads above the size threshold
+    # (or always under use_two_round_loading), 'true'/'false' force;
+    # the LIGHTGBM_TPU_STREAM_INGEST env knob overrides this param.
+    stream_ingest: str = "auto"
+    stream_chunk_rows: int = 0  # 0 = auto-size chunks (~32 MiB raw)
 
     # --- tree (TreeConfig, config.h:189–234)
     min_data_in_leaf: int = 20

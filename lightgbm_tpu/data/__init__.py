@@ -1,0 +1,29 @@
+"""Out-of-core streaming dataset construction.
+
+Counterpart of the reference's TextReader/PipelineReader + the sampling
+half of DatasetLoader, rebuilt for bounded-memory ingest:
+
+  ``reader``  chunked CSV/TSV/LibSVM parsers (one backend for streaming
+              AND single-shot loads)
+  ``sketch``  mergeable per-feature summaries (distinct-count maps
+              spilling to GK quantile sketches, Misra-Gries categorical
+              counts)
+  ``stats``   pass-1 collection: deterministic bin-construction sample +
+              sketch bank, with the cross-host merge
+  ``ingest``  two-pass orchestration: Dataset(path) -> packed bin matrix
+              without ever materializing the raw float matrix
+
+See docs/DATA.md for the pipeline contract and memory budget knobs.
+"""
+
+from .ingest import should_stream, stream_dataset  # noqa: F401
+from .reader import DenseChunkReader, LibSVMChunkReader, make_reader  # noqa: F401
+from .sketch import CategoricalSketch, GKSketch, NumericSketch  # noqa: F401
+from .stats import SampleCollector, SketchCollector  # noqa: F401
+
+__all__ = [
+    "should_stream", "stream_dataset",
+    "DenseChunkReader", "LibSVMChunkReader", "make_reader",
+    "GKSketch", "NumericSketch", "CategoricalSketch",
+    "SampleCollector", "SketchCollector",
+]

@@ -1,0 +1,367 @@
+"""Two-pass out-of-core dataset construction.
+
+The in-memory path (io/parser.load_text_file -> BinnedDataset.from_raw)
+materializes the whole file as a float64 matrix before binning — at
+Higgs scale (10.5M x 28) that is a 2.4 GB scratch allocation that dwarfs
+the 300 MB packed bin matrix actually kept.  This pipeline streams
+instead:
+
+  pass 0  count non-blank data lines (cheap byte scan, no parse)
+  pass 1  parse chunk-by-chunk: collect the deterministic
+          bin-construction row sample (bit-identical to the in-memory
+          sample: same LCG indices over the same row order) + mergeable
+          per-feature sketches (data/stats.py); find bins from the
+          sample
+  pass 2  parse chunk-by-chunk again, writing each chunk's bin indices
+          straight into the PREALLOCATED packed uint8/uint16 matrix
+
+Peak host memory is the packed matrix plus O(one chunk) — the raw float
+matrix never exists.  Because find-bin consumes exactly the sample the
+in-memory path would draw, the resulting BinMappers, packed matrix and
+any model trained from them are bit-identical to non-streaming
+construction of the same file.
+
+Routing: ``Dataset(path)`` streams when ``should_stream`` says so —
+``LIGHTGBM_TPU_STREAM_INGEST`` = ``0`` (never) / ``1`` (always) /
+``<MiB threshold>`` / ``auto`` (default: stream above
+``DEFAULT_AUTO_THRESHOLD_MB`` or when ``use_two_round_loading``, the
+reference's own low-memory loading flag, is set).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..io.parser import _resolve_column, _resolve_columns, _side_files
+from ..obs import tracer
+from ..obs.memory import host_rss_mb
+from ..utils.log import Log
+from .reader import DenseChunkReader, LibSVMChunkReader, make_reader
+from .stats import SampleCollector, SketchCollector
+
+DEFAULT_AUTO_THRESHOLD_MB = 256
+
+
+# ----------------------------------------------------------------------
+def stream_mode(config=None) -> str:
+    """'never' | 'always' | 'auto' | '<MiB>' from env + config.  The env
+    knob wins; config.stream_ingest is the param-file surface."""
+    v = os.environ.get("LIGHTGBM_TPU_STREAM_INGEST", "").strip().lower()
+    if not v or v == "auto":
+        v = str(getattr(config, "stream_ingest", "auto") or "auto").lower()
+    if v in ("0", "false", "off", "never"):
+        return "never"
+    if v in ("1", "true", "on", "always", "force"):
+        return "always"
+    return v  # 'auto' or a numeric MiB threshold
+
+
+def should_stream(path: str, config) -> bool:
+    mode = stream_mode(config)
+    if mode == "never":
+        return False
+    if mode == "always":
+        return True
+    threshold_mb = DEFAULT_AUTO_THRESHOLD_MB
+    if mode != "auto":
+        try:
+            threshold_mb = float(mode)
+        except ValueError:
+            Log.warning("Unparsable stream-ingest mode %r; using auto", mode)
+    if getattr(config, "use_two_round_loading", False):
+        # the reference's two-round loading IS the low-memory path
+        return True
+    try:
+        return os.path.getsize(path) > threshold_mb * (1 << 20)
+    except OSError:
+        return False
+
+
+# ----------------------------------------------------------------------
+@dataclass
+class ColumnRoles:
+    """Label/weight/group/ignore column assignment over the FULL parsed
+    column set — the exact slicing io/parser.load_text_file applies, so
+    streaming and in-memory loads pick identical feature columns."""
+
+    label_idx: int = 0
+    weight_col: int = -1
+    group_col: int = -1
+    keep: List[int] = field(default_factory=list)
+    feat_names: List[str] = field(default_factory=list)
+
+
+def resolve_roles(config, names: Optional[List[str]], ncols: int) -> ColumnRoles:
+    label_idx, _ = _resolve_column(config.label_column, names, default=0)
+    weight_idx, weight_abs = _resolve_column(config.weight_column, names, default=-1)
+    group_idx, group_abs = _resolve_column(config.group_column, names, default=-1)
+    ignore = _resolve_columns(config.ignore_column, names)
+
+    # numeric specs are label-relative and shift past the label column
+    # (config.h:119-133); name:-resolved are header-absolute
+    def absolute(idx: int, is_name: bool) -> int:
+        if idx < 0 or is_name:
+            return idx
+        return idx if idx < label_idx else idx + 1
+
+    roles = ColumnRoles(label_idx=label_idx)
+    drop = {label_idx}
+    if weight_idx >= 0:
+        roles.weight_col = absolute(weight_idx, weight_abs)
+        drop.add(roles.weight_col)
+    if group_idx >= 0:
+        roles.group_col = absolute(group_idx, group_abs)
+        drop.add(roles.group_col)
+    for ig, ig_abs in ignore:
+        drop.add(absolute(ig, ig_abs))
+    roles.keep = [i for i in range(ncols) if i not in drop]
+    roles.feat_names = (
+        [names[i] for i in roles.keep] if names
+        else [f"Column_{i}" for i in range(len(roles.keep))]
+    )
+    return roles
+
+
+def resolve_categorical(categorical_feature, feat_names: List[str]) -> set:
+    """Python-API categorical spec -> FEATURE-matrix column indices,
+    with the same name resolution basic.py applies."""
+    if categorical_feature in ("auto", None) or not categorical_feature:
+        return set()
+    cats = set()
+    for c in categorical_feature:
+        if isinstance(c, str):
+            if feat_names and c in feat_names:
+                cats.add(feat_names.index(c))
+            else:
+                Log.fatal("Unknown categorical feature %s", c)
+        else:
+            cats.add(int(c))
+    return cats
+
+
+# ----------------------------------------------------------------------
+def group_sizes_from_ids(gid: np.ndarray) -> np.ndarray:
+    """Query-id column -> per-query sizes (run lengths), identical to
+    the io/parser conversion."""
+    change = np.nonzero(np.diff(gid))[0] + 1
+    bounds = np.concatenate([[0], change, [len(gid)]])
+    return np.diff(bounds).astype(np.int64)
+
+
+class _RSSWatch:
+    """Peak host-RSS watermark over explicit ticks (obs gauge source)."""
+
+    def __init__(self):
+        self.start_mb = host_rss_mb()
+        self.peak_mb = self.start_mb
+
+    def tick(self) -> float:
+        rss = host_rss_mb()
+        if rss > self.peak_mb:
+            self.peak_mb = rss
+        return rss
+
+
+def stream_dataset(
+    path: str,
+    config,
+    *,
+    feature_name="auto",
+    categorical_feature="auto",
+    reference=None,
+    chunk_rows: Optional[int] = None,
+):
+    """Stream ``path`` into a BinnedDataset without materializing the
+    raw float matrix.  ``reference`` (a constructed BinnedDataset)
+    reuses its bin mappers — the CreateValid alignment path — and skips
+    pass 1 entirely."""
+    import time as _time
+
+    from ..io.dataset import (
+        BinnedDataset,
+        Metadata,
+        bin_rows_into,
+        bin_sample_indices,
+        find_bin_mappers_from_sample,
+        packed_bin_dtype,
+    )
+
+    t_start = _time.perf_counter()
+    rss = _RSSWatch()
+    if chunk_rows is None:
+        env_rows = os.environ.get("LIGHTGBM_TPU_STREAM_CHUNK_ROWS", "")
+        if env_rows:
+            chunk_rows = int(env_rows)
+        elif int(getattr(config, "stream_chunk_rows", 0) or 0) > 0:
+            chunk_rows = int(config.stream_chunk_rows)
+    reader = make_reader(path, chunk_rows=chunk_rows,
+                         has_header=config.has_header)
+    libsvm = isinstance(reader, LibSVMChunkReader)
+
+    # -- pass 0: row count (needed up front: the LCG sample draws
+    # indices over [0, n), exactly like DatasetLoader) ------------------
+    with tracer.span("ingest.pass0_count", path=path):
+        n = reader.count_rows()
+    if n == 0:
+        Log.fatal("Data file %s is empty", path)
+
+    report = {
+        "streamed": True,
+        "path": path,
+        "rows": int(n),
+        "libsvm": bool(libsvm),
+        "rss_start_mb": round(rss.start_mb, 1),
+    }
+
+    # -- pass 1: sample + sketches + (dense) column roles ---------------
+    roles: Optional[ColumnRoles] = None
+    sample_idx = bin_sample_indices(n, config)
+    sketches: Optional[SketchCollector] = None
+    sampled_feats = None
+    cats: set = set()
+    chunks_seen = 0
+
+    if reference is None:
+        collector = SampleCollector(
+            sample_idx, ncols=None if libsvm else reader.ncols
+        )
+        with tracer.span("ingest.pass1_stats", rows=int(n)):
+            if libsvm:
+                sketches = SketchCollector()
+                for start, feats, _labels in reader.iter_chunks():
+                    collector.offer(start, feats)
+                    sketches.update(feats)
+                    chunks_seen += 1
+                    tracer.counter("ingest.chunks", phase="pass1")
+                    tracer.gauge("ingest.host_rss_mb", rss.tick(), phase="pass1")
+                width = reader.ncols_seen
+                sampled_feats = collector.finish(ncols=width)
+                feat_names = [f"Column_{i}" for i in range(width)]
+                roles = ColumnRoles(label_idx=0,
+                                    keep=list(range(width)),
+                                    feat_names=feat_names)
+            else:
+                roles = resolve_roles(config, reader.header_names, reader.ncols)
+                if feature_name != "auto" and feature_name is not None:
+                    roles.feat_names = list(feature_name)
+                cats = resolve_categorical(categorical_feature, roles.feat_names)
+                sketches = SketchCollector(categorical=cats)
+                keep = np.asarray(roles.keep, dtype=np.int64)
+                for start, chunk in reader.iter_chunks():
+                    collector.offer(start, chunk)
+                    sketches.update(chunk[:, keep])
+                    chunks_seen += 1
+                    tracer.counter("ingest.chunks", phase="pass1")
+                    tracer.gauge("ingest.host_rss_mb", rss.tick(), phase="pass1")
+                sampled_feats = collector.finish()[:, keep]
+            if getattr(config, "is_parallel_find_bin", False):
+                from ..parallel.distributed import ensure_initialized
+
+                if ensure_initialized(config):
+                    # ingest mirror of distributed find-bin: every host
+                    # ends with the identical merged sketch bank
+                    sketches.merge_across_hosts()
+            tracer.event("ingest.sketches", **sketches.summary())
+
+        with tracer.span("ingest.find_bin", sample=int(len(sample_idx))):
+            mappers = find_bin_mappers_from_sample(sampled_feats, n, config, cats)
+            used = [i for i, m in enumerate(mappers) if not m.is_trivial]
+            if not used:
+                Log.fatal("Cannot construct Dataset: all features are trivial (constant)")
+            bin_mappers = [mappers[i] for i in used]
+            used_map = np.asarray(used, dtype=np.int32)
+        del sampled_feats, collector
+        report["sketch"] = sketches.summary()
+    else:
+        bin_mappers = reference.bin_mappers
+        used_map = reference.used_feature_map
+        if libsvm:
+            width = reference.num_total_features
+            roles = ColumnRoles(label_idx=0, keep=list(range(width)),
+                                feat_names=list(reference.feature_names))
+        else:
+            roles = resolve_roles(config, reader.header_names, reader.ncols)
+            roles.feat_names = list(reference.feature_names)
+
+    # -- pass 2: bin chunks into the preallocated packed matrix ---------
+    ds = BinnedDataset()
+    ds.num_total_features = (reference.num_total_features if reference is not None
+                             else len(roles.keep) if not libsvm else width)
+    ds.max_bin = reference.max_bin if reference is not None else config.max_bin
+    ds.bin_mappers = bin_mappers
+    ds.used_feature_map = used_map
+    ds.feature_names = roles.feat_names
+    ds.label_idx = roles.label_idx
+
+    dtype = packed_bin_dtype(bin_mappers)
+    binned = np.empty((n, len(bin_mappers)), dtype=dtype)
+    label = np.zeros(n, dtype=np.float32)
+    weights = np.empty(n, dtype=np.float32) if roles.weight_col >= 0 else None
+    gid = np.empty(n, dtype=np.float64) if roles.group_col >= 0 else None
+    keep = np.asarray(roles.keep, dtype=np.int64)
+
+    pass2_chunks = 0
+    with tracer.span("ingest.pass2_bin", rows=int(n)):
+        if libsvm:
+            target_w = (reference.num_total_features
+                        if reference is not None else width)
+            for start, feats, labels_chunk in reader.iter_chunks():
+                if feats.shape[1] < target_w:
+                    feats = np.pad(feats, ((0, 0), (0, target_w - feats.shape[1])))
+                elif feats.shape[1] > target_w:
+                    # columns unseen by pass 1 cannot happen (same file);
+                    # a reference narrower than the data truncates, like
+                    # ValueToBin's unseen-feature clamp
+                    feats = feats[:, :target_w]
+                bin_rows_into(binned, start, feats, bin_mappers, used_map)
+                label[start : start + len(labels_chunk)] = labels_chunk
+                pass2_chunks += 1
+                tracer.counter("ingest.chunks", phase="pass2")
+                tracer.gauge("ingest.host_rss_mb", rss.tick(), phase="pass2")
+        else:
+            for start, chunk in reader.iter_chunks():
+                stop = start + chunk.shape[0]
+                bin_rows_into(binned, start, chunk[:, keep], bin_mappers, used_map)
+                label[start:stop] = chunk[:, roles.label_idx].astype(np.float32)
+                if weights is not None:
+                    weights[start:stop] = chunk[:, roles.weight_col].astype(np.float32)
+                if gid is not None:
+                    gid[start:stop] = chunk[:, roles.group_col]
+                pass2_chunks += 1
+                tracer.counter("ingest.chunks", phase="pass2")
+                tracer.gauge("ingest.host_rss_mb", rss.tick(), phase="pass2")
+
+    ds.binned = binned
+    ds.metadata = Metadata(n)
+    ds.metadata.set_label(label)
+    group = group_sizes_from_ids(gid) if gid is not None else None
+
+    # side files fill whatever the columns didn't provide (metadata.cpp)
+    fweights, fgroup = _side_files(path, n)
+    if weights is None:
+        weights = fweights
+    if group is None:
+        group = fgroup
+    ds.metadata.set_weights(weights)
+    ds.metadata.set_query(group)
+
+    rss.tick()
+    report.update({
+        "chunks_pass1": int(chunks_seen),
+        "chunks_pass2": int(pass2_chunks),
+        "chunk_rows": int(reader.chunk_rows()),
+        "num_features_used": int(len(bin_mappers)),
+        "packed_mb": round(binned.nbytes / 1e6, 1),
+        "rss_peak_mb": round(rss.peak_mb, 1),
+        "wall_s": round(_time.perf_counter() - t_start, 3),
+    })
+    report["rows_per_s"] = round(n / max(report["wall_s"], 1e-9), 1)
+    ds.ingest_report = report
+    tracer.event("ingest.done", **{k: v for k, v in report.items()
+                                   if not isinstance(v, dict)})
+    tracer.gauge("ingest.rss_peak_mb", rss.peak_mb)
+    return ds

@@ -1,0 +1,329 @@
+"""Chunked text readers — counterpart of the reference's TextReader /
+PipelineReader (include/LightGBM/utils/text_reader.h,
+pipeline_reader.h): stream a CSV/TSV/LibSVM file as bounded-size row
+chunks so no caller ever needs the whole raw float matrix in memory.
+
+One parsing code path: the legacy single-shot ``io/parser.load_text_file``
+and the two-pass streaming ingest (data/ingest.py) both parse through
+these readers, so dense and streaming loads cannot drift in dtype or
+missing-value semantics.  Per-chunk parsing backend: the native
+multithreaded parser (native/parser.cpp, reference-exact Atof) when a
+compiler is available, else pandas' C engine — the SAME backend choice
+for every chunk of a file, whatever the chunk size.
+
+Chunking is by NON-BLANK lines (the native scanner and the reference's
+TextReader both index non-blank lines), so chunk boundaries never change
+parsed values: a file read as one chunk and as two hundred chunks yields
+bit-identical rows.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.log import Log
+
+# default per-chunk raw-matrix budget when chunk_rows is not forced
+DEFAULT_CHUNK_BYTES = 32 << 20  # 32 MiB of float64 cells per chunk
+MIN_CHUNK_ROWS = 1024
+MAX_CHUNK_ROWS = 1 << 21
+
+
+def auto_chunk_rows(ncols: int, chunk_bytes: int = DEFAULT_CHUNK_BYTES) -> int:
+    rows = chunk_bytes // max(8 * max(ncols, 1), 1)
+    return int(min(max(rows, MIN_CHUNK_ROWS), MAX_CHUNK_ROWS))
+
+
+def iter_line_blocks(path: str, chunk_lines: int,
+                     skip_lines: int = 0) -> Iterator[Tuple[int, bytes, int]]:
+    """Yield ``(start_line, block_bytes, num_lines)`` where lines are
+    counted over NON-BLANK lines only and ``start_line`` is the index of
+    the block's first non-blank line after ``skip_lines`` were dropped.
+    Memory is bounded by one block."""
+    buf: List[bytes] = []
+    start = 0
+    n_in_buf = 0
+    skipped = 0
+    with open(path, "rb") as f:
+        for raw in f:
+            if not raw.strip():
+                continue
+            if skipped < skip_lines:
+                skipped += 1
+                continue
+            buf.append(raw)
+            n_in_buf += 1
+            if n_in_buf >= chunk_lines:
+                yield start, b"".join(buf), n_in_buf
+                start += n_in_buf
+                buf, n_in_buf = [], 0
+    if buf:
+        yield start, b"".join(buf), n_in_buf
+
+
+def count_data_lines(path: str, skip_lines: int = 0) -> int:
+    """Cheap pass-0 row count: non-blank lines minus the header."""
+    n = 0
+    with open(path, "rb") as f:
+        for raw in f:
+            if raw.strip():
+                n += 1
+    return max(0, n - skip_lines)
+
+
+def read_header_names(path: str, sep: Optional[str]) -> List[str]:
+    """First non-blank line parsed as column names (quote-aware via
+    pandas when the line carries quotes)."""
+    with open(path, "rb") as f:
+        first = b""
+        for raw in f:
+            if raw.strip():
+                first = raw
+                break
+    text = first.decode("utf-8", "replace").strip()
+    if '"' in text or "'" in text:
+        import pandas as pd
+
+        df = pd.read_csv(io.StringIO(text), sep=sep or r"\s+", header=0,
+                         engine="python", nrows=0)
+        return [str(c) for c in df.columns]
+    sp = None if sep in (None, r"\s+") else sep
+    return [t.strip() for t in text.split(sp)]
+
+
+# ----------------------------------------------------------------------
+def _native_parse_block(block: bytes, sep: str) -> Optional[np.ndarray]:
+    """Parse one dense block with the native parser (reference-exact
+    Atof).  Returns None to signal the pandas fallback."""
+    from ..native import get_lib
+
+    lib = get_lib()
+    if lib is None:
+        return None
+    import ctypes
+
+    sep_b = b" " if sep == r"\s+" else sep.encode()
+    handle = lib.ltpu_scan(block, len(block))
+    try:
+        nrows = ctypes.c_int64()
+        ncols = ctypes.c_int()
+        if lib.ltpu_dims_csv(handle, block, sep_b, 0,
+                             ctypes.byref(nrows), ctypes.byref(ncols)) != 0:
+            return None
+        mat = np.empty((nrows.value, ncols.value), dtype=np.float64)
+        rc = lib.ltpu_parse_csv(
+            handle, block, sep_b, 0,
+            mat.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            nrows.value, ncols.value, min(os.cpu_count() or 1, 16),
+        )
+        if rc != 0:
+            return None
+        return mat
+    finally:
+        lib.ltpu_scan_free(handle)
+
+
+def _pandas_parse_block(block: bytes, sep: str) -> np.ndarray:
+    import pandas as pd
+
+    df = pd.read_csv(
+        io.BytesIO(block), sep=sep, header=None,
+        engine="c" if sep != r"\s+" else "python",
+    )
+    return df.to_numpy(dtype=np.float64)
+
+
+class DenseChunkReader:
+    """Chunked reader for CSV/TSV files.  Every chunk is the FULL column
+    set (label/weight/group columns included) — column-role slicing is
+    the caller's job, exactly like the reference's parser emitting all
+    (idx, value) pairs."""
+
+    def __init__(self, path: str, sep: str, has_header: bool,
+                 chunk_rows: Optional[int] = None):
+        self.path = path
+        self.sep = sep
+        self.has_header = has_header
+        self.header_names: Optional[List[str]] = (
+            read_header_names(path, sep) if has_header else None
+        )
+        self._chunk_rows = chunk_rows
+        self._num_rows: Optional[int] = None
+        self._ncols: Optional[int] = None
+
+    # -- pass 0 --------------------------------------------------------
+    def count_rows(self) -> int:
+        if self._num_rows is None:
+            self._num_rows = count_data_lines(
+                self.path, skip_lines=1 if self.has_header else 0
+            )
+        return self._num_rows
+
+    @property
+    def ncols(self) -> int:
+        if self._ncols is None:
+            for _, chunk in self.iter_chunks(probe_rows=MIN_CHUNK_ROWS):
+                self._ncols = chunk.shape[1]
+                break
+            if self._ncols is None:
+                Log.fatal("Data file %s is empty", self.path)
+        return self._ncols
+
+    def chunk_rows(self) -> int:
+        if self._chunk_rows:
+            return int(self._chunk_rows)
+        return auto_chunk_rows(self.ncols)
+
+    # -- chunk iteration ----------------------------------------------
+    def parse_block(self, block: bytes) -> np.ndarray:
+        mat = _native_parse_block(block, self.sep)
+        if mat is None:
+            mat = _pandas_parse_block(block, self.sep)
+        if self._ncols is None:
+            self._ncols = mat.shape[1]
+        elif mat.shape[1] != self._ncols:
+            Log.fatal(
+                "Inconsistent column count in %s: chunk has %d, expected %d",
+                self.path, mat.shape[1], self._ncols,
+            )
+        return mat
+
+    def iter_chunks(self, probe_rows: Optional[int] = None
+                    ) -> Iterator[Tuple[int, np.ndarray]]:
+        """Yield ``(start_row, (rows, ncols) float64 matrix)``."""
+        rows = probe_rows or self.chunk_rows()
+        skip = 1 if self.has_header else 0
+        for start, block, _ in iter_line_blocks(self.path, rows, skip):
+            yield start, self.parse_block(block)
+
+    def read_all(self) -> Tuple[np.ndarray, Optional[List[str]]]:
+        """Single-shot load (legacy io/parser path): one chunk spanning
+        the file, so the memory profile matches the old whole-file
+        parse."""
+        chunks = [c for _, c in self.iter_chunks(probe_rows=MAX_CHUNK_ROWS)]
+        if not chunks:
+            Log.fatal("Data file %s is empty", self.path)
+        mat = chunks[0] if len(chunks) == 1 else np.vstack(chunks)
+        return mat, self.header_names
+
+
+# ----------------------------------------------------------------------
+class LibSVMChunkReader:
+    """Chunked LibSVM reader.  Chunks are ``(features, labels)``; the
+    global feature count is the max seen index + 1, discovered during
+    pass 1 (``grow_ncols``) and then frozen for pass 2 via ``set_ncols``."""
+
+    def __init__(self, path: str, chunk_rows: Optional[int] = None):
+        self.path = path
+        self.has_header = False
+        self.header_names = None
+        self._chunk_rows = chunk_rows
+        self._num_rows: Optional[int] = None
+        self.ncols_seen = 0  # grows as chunks are parsed
+
+    def count_rows(self) -> int:
+        if self._num_rows is None:
+            self._num_rows = count_data_lines(self.path)
+        return self._num_rows
+
+    def chunk_rows(self) -> int:
+        if self._chunk_rows:
+            return int(self._chunk_rows)
+        return auto_chunk_rows(32)
+
+    def parse_block(self, block: bytes) -> Tuple[np.ndarray, np.ndarray]:
+        mat_lab = self._native_parse(block)
+        if mat_lab is None:
+            mat_lab = self._python_parse(block)
+        feats, labels = mat_lab
+        self.ncols_seen = max(self.ncols_seen, feats.shape[1])
+        return feats, labels
+
+    def _native_parse(self, block: bytes):
+        from ..native import get_lib
+
+        lib = get_lib()
+        if lib is None:
+            return None
+        import ctypes
+
+        handle = lib.ltpu_scan(block, len(block))
+        try:
+            nrows = ctypes.c_int64()
+            ncols = ctypes.c_int()
+            if lib.ltpu_dims_libsvm(handle, block, ctypes.byref(nrows),
+                                    ctypes.byref(ncols)) != 0:
+                return None
+            mat = np.zeros((nrows.value, ncols.value), dtype=np.float64)
+            labels = np.empty(nrows.value, dtype=np.float64)
+            pd_ = ctypes.POINTER(ctypes.c_double)
+            rc = lib.ltpu_parse_libsvm(
+                handle, block, mat.ctypes.data_as(pd_),
+                labels.ctypes.data_as(pd_),
+                nrows.value, ncols.value, min(os.cpu_count() or 1, 16),
+            )
+            if rc != 0:
+                return None
+            return mat, labels.astype(np.float32)
+        finally:
+            lib.ltpu_scan_free(handle)
+
+    def _python_parse(self, block: bytes) -> Tuple[np.ndarray, np.ndarray]:
+        labels: List[float] = []
+        rows: List[List[Tuple[int, float]]] = []
+        max_idx = -1
+        for line in block.split(b"\n"):
+            toks = line.split()
+            if not toks:
+                continue
+            labels.append(float(toks[0]))
+            row: List[Tuple[int, float]] = []
+            for t in toks[1:]:
+                i, v = t.split(b":")
+                idx = int(i)
+                row.append((idx, float(v)))
+                max_idx = max(max_idx, idx)
+            rows.append(row)
+        mat = np.zeros((len(rows), max_idx + 1), dtype=np.float64)
+        for r, row in enumerate(rows):
+            for idx, v in row:
+                mat[r, idx] = v
+        return mat, np.asarray(labels, dtype=np.float32)
+
+    def iter_chunks(self) -> Iterator[Tuple[int, np.ndarray, np.ndarray]]:
+        """Yield ``(start_row, features, labels)``.  Feature matrices are
+        chunk-local width; callers pad to a global width (``ncols_seen``
+        after a full pass, or a frozen pass-1 count)."""
+        for start, block, _ in iter_line_blocks(self.path, self.chunk_rows()):
+            feats, labels = self.parse_block(block)
+            yield start, feats, labels
+
+    def read_all(self) -> Tuple[np.ndarray, np.ndarray]:
+        feats_list, labels_list = [], []
+        for _, feats, labels in self.iter_chunks():
+            feats_list.append(feats)
+            labels_list.append(labels)
+        if not feats_list:
+            Log.fatal("Data file %s is empty", self.path)
+        width = self.ncols_seen
+        padded = [
+            np.pad(f, ((0, 0), (0, width - f.shape[1]))) if f.shape[1] < width else f
+            for f in feats_list
+        ]
+        return np.vstack(padded), np.concatenate(labels_list)
+
+
+def make_reader(path: str, chunk_rows: Optional[int] = None,
+                has_header: bool = False):
+    """Sniff the format (io/parser.sniff_format) and build the matching
+    chunked reader."""
+    from ..io.parser import sniff_format
+
+    kind, sep = sniff_format(path)
+    if kind == "libsvm":
+        return LibSVMChunkReader(path, chunk_rows=chunk_rows)
+    return DenseChunkReader(path, sep, has_header, chunk_rows=chunk_rows)

@@ -1,0 +1,207 @@
+"""Pass-1 streaming statistics: per-feature mergeable sketches plus the
+deterministic bin-construction row sample.
+
+The sample (io/dataset.bin_sample_indices) is what find-bin actually
+consumes — it makes streaming construction bit-identical to the
+in-memory path.  The sketches are the *mergeable* superset the sample
+cannot give: exact distinct-value/cardinality accounting per feature
+(spilling to GK quantile summaries above a cap), collected chunk by
+chunk with O(cap) memory and merged associatively across chunks or
+hosts (parallel/collect.py), mirroring the reference's distributed
+find-bin allgather.  They feed diagnostics (ingest trace gauges),
+``BinMapper.find_bin_from_distinct`` for sketch-driven binning, and the
+distributed ingest merge.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .sketch import (
+    DEFAULT_CARDINALITY_CAP,
+    DEFAULT_GK_EPS,
+    CategoricalSketch,
+    NumericSketch,
+    deserialize_sketches,
+    merge_sketch_lists,
+    serialize_sketches,
+)
+
+
+class SampleCollector:
+    """Collects the rows whose global index is in the (sorted) sample
+    index set, with one forward cursor — the streaming equivalent of
+    ``data[sample_indices]``.  With ``ncols`` known up front (dense
+    files) rows land in a preallocated matrix; ``ncols=None`` (LibSVM,
+    where width grows with the max seen index) keeps per-row vectors and
+    pads at ``finish(ncols=...)``."""
+
+    def __init__(self, sample_indices: np.ndarray, ncols: Optional[int] = None):
+        self.indices = np.asarray(sample_indices, dtype=np.int64)
+        self.rows: Optional[np.ndarray] = (
+            np.empty((len(self.indices), ncols), dtype=np.float64)
+            if ncols is not None else None
+        )
+        self._row_list: List[np.ndarray] = []
+        self._cursor = 0
+
+    def offer(self, start_row: int, chunk: np.ndarray) -> None:
+        stop_row = start_row + chunk.shape[0]
+        c = self._cursor
+        while c < len(self.indices) and self.indices[c] < stop_row:
+            row = chunk[self.indices[c] - start_row]
+            if self.rows is not None:
+                self.rows[c] = row
+            else:
+                self._row_list.append(np.asarray(row, np.float64))
+            c += 1
+        self._cursor = c
+
+    def finish(self, ncols: Optional[int] = None) -> np.ndarray:
+        if self._cursor != len(self.indices):
+            raise RuntimeError(
+                f"sample collection incomplete: {self._cursor}/{len(self.indices)}"
+            )
+        if self.rows is not None:
+            return self.rows
+        width = ncols if ncols is not None else max(
+            (len(r) for r in self._row_list), default=0
+        )
+        out = np.zeros((len(self._row_list), width), dtype=np.float64)
+        for i, r in enumerate(self._row_list):
+            out[i, : len(r)] = r[:width]
+        return out
+
+
+class SketchCollector:
+    """Per-feature sketch bank, updated chunk by chunk.
+
+    ``categorical`` holds FEATURE indices (post label/weight-drop) that
+    get a CategoricalSketch; everything else is numeric.  Features may
+    appear late (LibSVM width growth): a new column's sketch is
+    back-filled with the zero count of every row already seen, so its
+    totals match a column that was materialized from row 0."""
+
+    def __init__(self, categorical: Optional[set] = None,
+                 cap: int = DEFAULT_CARDINALITY_CAP,
+                 eps: float = DEFAULT_GK_EPS):
+        self.categorical = set(categorical or ())
+        self.cap = cap
+        self.eps = eps
+        self.sketches: List[object] = []
+        self.rows_seen = 0
+
+    def _new_sketch(self, fidx: int):
+        if fidx in self.categorical:
+            return CategoricalSketch(cap=self.cap)
+        return NumericSketch(cap=self.cap, eps=self.eps)
+
+    def _grow_to(self, ncols: int) -> None:
+        while len(self.sketches) < ncols:
+            s = self._new_sketch(len(self.sketches))
+            if self.rows_seen:
+                # rows seen before this column appeared are implicit zeros
+                s.total_cnt += self.rows_seen
+                if isinstance(s, NumericSketch):
+                    s.zero_cnt += self.rows_seen
+                else:
+                    s.counts[0] = s.counts.get(0, 0) + self.rows_seen
+            self.sketches.append(s)
+
+    def update(self, features: np.ndarray) -> None:
+        """Fold one chunk's FEATURE matrix in (chunk-local width is
+        allowed; missing trailing columns count as zeros)."""
+        rows, width = features.shape
+        self._grow_to(width)
+        for f, sk in enumerate(self.sketches):
+            if f < width:
+                sk.update(features[:, f])
+            else:
+                sk.total_cnt += rows
+                if isinstance(sk, NumericSketch):
+                    sk.zero_cnt += rows
+                else:
+                    sk.counts[0] = sk.counts.get(0, 0) + rows
+        self.rows_seen += rows
+
+    # ------------------------------------------------------------------
+    def summary(self) -> dict:
+        """Trace-friendly digest: per-feature cardinality and spill
+        state (what the ingest span attaches as gauges)."""
+        spilled = sum(
+            1 for s in self.sketches
+            if getattr(s, "spilled", False)
+        )
+        cards = [s.cardinality() if isinstance(s, NumericSketch)
+                 else len(s.counts) for s in self.sketches]
+        return {
+            "features": len(self.sketches),
+            "spilled": spilled,
+            "max_cardinality": int(max(cards, default=0)),
+        }
+
+    def merge_across_hosts(self) -> None:
+        """Allgather + feature-wise merge of every host's sketch bank —
+        the ingest mirror of distributed find-bin.  No-op when
+        single-process."""
+        import jax
+
+        if jax.process_count() == 1:
+            return
+        from ..parallel.collect import allgather_bytes
+
+        blobs = allgather_bytes(serialize_sketches(self.sketches))
+        lists = [deserialize_sketches(b) for b in blobs]
+        width = max(len(lst) for lst in lists)
+        for lst in lists:
+            # narrower hosts saw fewer LibSVM columns: widen with
+            # zero-backfilled sketches so the feature-wise zip lines up
+            rows = lst[0].total_cnt if lst else 0
+            while len(lst) < width:
+                s = self._new_sketch(len(lst))
+                s.total_cnt += rows
+                if isinstance(s, NumericSketch):
+                    s.zero_cnt += rows
+                else:
+                    s.counts[0] = s.counts.get(0, 0) + rows
+                lst.append(s)
+        merged = merge_sketch_lists(lists)
+        self.sketches = merged
+        self.rows_seen = merged[0].total_cnt if merged else 0
+
+
+def mappers_from_sketches(
+    collector: SketchCollector,
+    total_rows: int,
+    config,
+    categorical: Optional[Sequence[int]] = None,
+) -> List:
+    """Sketch-driven find-bin: feed each feature's (distinct, count)
+    summary through ``BinMapper.find_bin_from_distinct``.  Bit-identical
+    to in-memory find-bin over the same rows while every sketch is
+    exact; approximate (bounded by the GK eps) after a spill.  Used when
+    the full-data statistics, not a row sample, should define the bins
+    (``bin_construct_sample_cnt >= num_rows`` streaming runs and the
+    distributed ingest merge)."""
+    from ..io.binning import CATEGORICAL, NUMERICAL, BinMapper
+
+    cats = set(categorical or ())
+    filter_cnt = int(config.min_data_in_leaf)
+    mappers = []
+    for f, sk in enumerate(collector.sketches):
+        vals, cnts = sk.to_distinct_counts()
+        # find-bin's contract: zeros (and NaNs, which FindBin folds into
+        # the zero block) ride ``total - counts.sum()``.  NumericSketch
+        # excludes both from its distinct map, so passing total_cnt
+        # implies them exactly; CategoricalSketch keeps category 0
+        # in-band, which FindBin's zero-insert logic accepts unchanged.
+        m = BinMapper()
+        m.find_bin_from_distinct(
+            vals, cnts, sk.total_cnt, config.max_bin,
+            config.min_data_in_bin, filter_cnt,
+            CATEGORICAL if f in cats else NUMERICAL,
+        )
+        mappers.append(m)
+    return mappers

@@ -139,15 +139,34 @@ class BinMapper:
         ``total_sample_cnt`` = len(sample_values) + number of zero entries,
         exactly as the reference passes them (FindBin, bin.cpp:137).
         """
+        values = np.asarray(sample_values, dtype=np.float64)
+        distinct_arr, counts_arr = np.unique(values, return_counts=True)
+        self.find_bin_from_distinct(
+            distinct_arr, counts_arr.astype(np.int64), total_sample_cnt,
+            max_bin, min_data_in_bin, min_split_data, bin_type,
+        )
+
+    def find_bin_from_distinct(
+        self,
+        distinct_values: np.ndarray,
+        counts: np.ndarray,
+        total_sample_cnt: int,
+        max_bin: int,
+        min_data_in_bin: int,
+        min_split_data: int,
+        bin_type: int = NUMERICAL,
+    ) -> None:
+        """``find_bin`` over pre-aggregated (distinct non-zero value,
+        count) pairs — the entry point for mergeable streaming sketches
+        (data/sketch.py): a sketch that is still exact reproduces the
+        raw-sample mapper bit-for-bit, a spilled one feeds its summary
+        representatives.  ``total_sample_cnt - counts.sum()`` is the
+        implied zero/missing count, same contract as ``find_bin``."""
         self.bin_type = bin_type
         self.default_bin = 0
-        values = np.asarray(sample_values, dtype=np.float64)
-        zero_cnt = int(total_sample_cnt - len(values))
-
-        # distinct values with the implicit zero block inserted in order
-        # (FindBin's zero push-front/middle/back, bin.cpp:146–176)
-        distinct_arr, counts_arr = np.unique(values, return_counts=True)
-        counts_arr = counts_arr.astype(np.int64)
+        distinct_arr = np.asarray(distinct_values, dtype=np.float64)
+        counts_arr = np.asarray(counts, dtype=np.int64)
+        zero_cnt = int(total_sample_cnt - counts_arr.sum())
         insert_at: Optional[int] = None
         if len(distinct_arr) == 0 or (distinct_arr[0] > 0.0 and zero_cnt > 0):
             insert_at = 0

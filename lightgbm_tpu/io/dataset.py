@@ -375,7 +375,7 @@ def _find_bin_mappers_distributed(
 
     import pickle
 
-    from jax.experimental import multihost_utils
+    from ..parallel.collect import allgather_blob_lists
 
     nproc = jax.process_count()
     rank = jax.process_index()
@@ -390,19 +390,11 @@ def _find_bin_mappers_distributed(
     else:
         local = []
     blobs = [pickle.dumps(m.state()) for m in local]
-    maxlen = max([len(b) for b in blobs], default=1)
-    gmax = int(np.max(multihost_utils.process_allgather(np.asarray(maxlen, np.int64))))
-    buf = np.zeros((step, gmax + 8), np.uint8)
-    for i, b in enumerate(blobs):
-        buf[i, :8] = np.frombuffer(len(b).to_bytes(8, "little"), np.uint8)
-        buf[i, 8 : 8 + len(b)] = np.frombuffer(b, np.uint8)
-    gathered = np.asarray(multihost_utils.process_allgather(buf))  # (M, step, gmax+8)
+    gathered = allgather_blob_lists(blobs, list_len=step)
     mappers: List[BinMapper] = []
     for f in range(f_total):
         r, i = divmod(f, step)
-        row = gathered[r, i]
-        ln = int.from_bytes(row[:8].tobytes(), "little")
-        mappers.append(BinMapper.from_state(pickle.loads(row[8 : 8 + ln].tobytes())))
+        mappers.append(BinMapper.from_state(pickle.loads(gathered[r][i])))
     return mappers
 
 
@@ -415,17 +407,36 @@ def _find_bin_mappers(
     """Sample rows then FindBin per feature (dataset_loader.cpp:661–776)."""
     n = data.shape[0]
     if sample_indices is None:
-        rng = Random(config.data_random_seed)
-        sample_cnt = min(config.bin_construct_sample_cnt, n)
-        sample_indices = rng.sample(n, sample_cnt)
-    sampled = data[sample_indices]
+        sample_indices = bin_sample_indices(n, config)
+    return find_bin_mappers_from_sample(data[sample_indices], n, config, categorical)
+
+
+def bin_sample_indices(n: int, config: Config) -> np.ndarray:
+    """The deterministic bin-construction row sample (DatasetLoader's
+    ``random_.Sample(num_data, bin_construct_sample_cnt)``).  Sorted
+    ascending, so a streaming pass can collect the rows with a single
+    forward cursor and end up with EXACTLY the matrix the in-memory path
+    samples — the anchor of streaming/in-memory bit-parity."""
+    rng = Random(config.data_random_seed)
+    sample_cnt = min(config.bin_construct_sample_cnt, n)
+    return rng.sample(n, sample_cnt)
+
+
+def find_bin_mappers_from_sample(
+    sampled: np.ndarray,
+    total_rows: int,
+    config: Config,
+    categorical: set,
+) -> List[BinMapper]:
+    """FindBin per feature over an already-collected sample matrix.
+    ``total_rows`` is the FULL dataset row count — min_data_in_leaf is
+    scaled by the sampling fraction, exactly like
+    dataset_loader.cpp:491-492 / :709-710 (sampled per-bin counts are
+    proportionally smaller than full-data counts)."""
     total = sampled.shape[0]
-    # min_data_in_leaf scaled by the sampling fraction, exactly like
-    # dataset_loader.cpp:491-492 / :709-710 — sampled per-bin counts are
-    # proportionally smaller than full-data counts.
-    filter_cnt = int(config.min_data_in_leaf * total / max(n, 1))
+    filter_cnt = int(config.min_data_in_leaf * total / max(total_rows, 1))
     mappers: List[BinMapper] = []
-    for f in range(data.shape[1]):
+    for f in range(sampled.shape[1]):
         col = sampled[:, f]
         col = col[~np.isnan(col)]
         nonzero = col[col != 0.0]
@@ -442,10 +453,31 @@ def _find_bin_mappers(
     return mappers
 
 
-def _bin_matrix(data: np.ndarray, mappers: List[BinMapper], used_map: np.ndarray) -> np.ndarray:
+def packed_bin_dtype(mappers: List[BinMapper]):
+    """uint8 unless some feature needs >256 bins (the packed-matrix
+    sizing rule, shared with the streaming pass-2 preallocation)."""
     max_bins = max((m.num_bin for m in mappers), default=2)
-    dtype = np.uint8 if max_bins <= 256 else np.uint16
-    out = np.empty((data.shape[0], len(mappers)), dtype=dtype)
+    return np.uint8 if max_bins <= 256 else np.uint16
+
+
+def bin_rows_into(
+    out: np.ndarray,
+    start: int,
+    data: np.ndarray,
+    mappers: List[BinMapper],
+    used_map: np.ndarray,
+) -> None:
+    """Bin raw rows directly into ``out[start:start+len(data)]`` — the
+    pass-2 streaming write: each chunk lands in the preallocated packed
+    matrix and the raw floats are dropped."""
+    stop = start + data.shape[0]
     for inner, real in enumerate(used_map):
-        out[:, inner] = mappers[inner].value_to_bin(data[:, int(real)]).astype(dtype)
+        out[start:stop, inner] = (
+            mappers[inner].value_to_bin(data[:, int(real)]).astype(out.dtype)
+        )
+
+
+def _bin_matrix(data: np.ndarray, mappers: List[BinMapper], used_map: np.ndarray) -> np.ndarray:
+    out = np.empty((data.shape[0], len(mappers)), dtype=packed_bin_dtype(mappers))
+    bin_rows_into(out, 0, data, mappers, used_map)
     return out

@@ -7,10 +7,11 @@ non-empty lines; ':'-separated index:value tokens ⇒ LibSVM, otherwise the
 delimiter (tab/comma/space) picks TSV/CSV.  Side files ``<data>.weight``
 and ``<data>.query`` are picked up like Metadata::Init (metadata.cpp).
 
-Dense parsing is delegated to pandas.read_csv (C engine) — the runtime
-replacement for the reference's multithreaded TextReader pipeline — with a
-numpy fallback.  A native C++ chunked parser can be slotted in behind
-``load_text_file`` later without touching callers.
+Parsing is delegated to the chunked readers in data/reader.py (native
+multithreaded parser per block, pandas C engine fallback) — the SAME code
+path the out-of-core streaming ingest uses, so single-shot and streaming
+loads cannot drift.  This module keeps the column-role slicing
+(label/weight/group/ignore) and side-file conventions.
 """
 
 from __future__ import annotations
@@ -62,26 +63,22 @@ def load_text_file(
     the reference's parsers emit (feature_idx, value) pairs with the label
     split out.
     """
+    # one parsing code path with the streaming ingest (data/reader.py):
+    # single-shot loads read through the SAME chunked readers (native
+    # parser per block when a compiler is available, pandas C engine
+    # otherwise), so dense and streaming loads cannot drift in dtype or
+    # missing-value semantics.  Imported lazily — data/ sits above io/.
+    from ..data.reader import DenseChunkReader, LibSVMChunkReader
+
     kind, sep = sniff_format(path)
     if kind == "libsvm":
-        raw, label = _load_libsvm(path)
+        raw, label = LibSVMChunkReader(path).read_all()
         names = [f"Column_{i}" for i in range(raw.shape[1])]
         label_idx = 0
         weights, group = _side_files(path, raw.shape[0])
         return raw, label, weights, group, names, label_idx
 
-    res = _native_parse_dense(path, sep, config.has_header)
-    if res is not None:
-        mat, names = res
-    else:
-        import pandas as pd
-
-        header = 0 if config.has_header else None
-        df = pd.read_csv(
-            path, sep=sep, header=header, engine="c" if sep != r"\s+" else "python"
-        )
-        names = [str(c) for c in df.columns] if config.has_header else None
-        mat = df.to_numpy(dtype=np.float64)
+    mat, names = DenseChunkReader(path, sep, config.has_header).read_all()
 
     label_idx, _ = _resolve_column(config.label_column, names, default=0)
     weight_idx, weight_abs = _resolve_column(config.weight_column, names, default=-1)
@@ -173,114 +170,9 @@ def _side_files(path: str, num_data: int):
     return weights, group
 
 
-def _native_parse_dense(
-    path: str, sep: str, has_header: bool
-) -> Optional[Tuple[np.ndarray, Optional[List[str]]]]:
-    """Parse a dense CSV/TSV with the native multithreaded parser.
-
-    Uses reference-exact Atof float semantics (common.h:163-261) — see
-    native/parser.cpp for why bit-identical parsing matters for model
-    parity.  Returns (matrix, header_names_or_None), or None to signal
-    the caller to fall back to pandas.
-    """
-    from ..native import get_lib
-
-    lib = get_lib()
-    if lib is None:
-        return None
-    import ctypes
-
-    sep_b = b" " if sep == r"\s+" else sep.encode()
-    with open(path, "rb") as f:
-        buf = f.read()
-    names: Optional[List[str]] = None
-    skip = 0
-    if has_header:
-        # first NON-BLANK line (the native scanner indexes non-blank
-        # lines, so skip=1 must drop exactly this line); quoted headers
-        # go to the pandas path, which parses quoting properly
-        first = next(
-            (ln for ln in buf.split(b"\n") if ln.strip()), b""
-        ).decode("utf-8", "replace").strip()
-        if '"' in first or "'" in first:
-            return None
-        sp = None if sep == r"\s+" else sep
-        names = [t.strip() for t in first.split(sp)]
-        skip = 1
-    handle = lib.ltpu_scan(buf, len(buf))
-    try:
-        nrows = ctypes.c_int64()
-        ncols = ctypes.c_int()
-        if lib.ltpu_dims_csv(handle, buf, sep_b, skip,
-                             ctypes.byref(nrows), ctypes.byref(ncols)) != 0:
-            return None
-        mat = np.empty((nrows.value, ncols.value), dtype=np.float64)
-        nthreads = min(os.cpu_count() or 1, 16)
-        rc = lib.ltpu_parse_csv(
-            handle, buf, sep_b, skip,
-            mat.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-            nrows.value, ncols.value, nthreads,
-        )
-        if rc != 0:
-            return None
-        return mat, names
-    finally:
-        lib.ltpu_scan_free(handle)
-
-
-def _native_parse_libsvm(path: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:
-    from ..native import get_lib
-
-    lib = get_lib()
-    if lib is None:
-        return None
-    import ctypes
-
-    with open(path, "rb") as f:
-        buf = f.read()
-    handle = lib.ltpu_scan(buf, len(buf))
-    try:
-        nrows = ctypes.c_int64()
-        ncols = ctypes.c_int()
-        if lib.ltpu_dims_libsvm(handle, buf, ctypes.byref(nrows),
-                                ctypes.byref(ncols)) != 0:
-            return None
-        mat = np.zeros((nrows.value, ncols.value), dtype=np.float64)
-        labels = np.empty(nrows.value, dtype=np.float64)
-        pd_ = ctypes.POINTER(ctypes.c_double)
-        rc = lib.ltpu_parse_libsvm(
-            handle, buf, mat.ctypes.data_as(pd_), labels.ctypes.data_as(pd_),
-            nrows.value, ncols.value, min(os.cpu_count() or 1, 16),
-        )
-        if rc != 0:
-            return None
-        return mat, labels.astype(np.float32)
-    finally:
-        lib.ltpu_scan_free(handle)
-
-
 def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray]:
-    native = _native_parse_libsvm(path)
-    if native is not None:
-        return native
-    labels: List[float] = []
-    rows: List[List[Tuple[int, float]]] = []
-    max_idx = -1
-    with open(path, "r") as f:
-        for line in f:
-            toks = line.split()
-            if not toks:
-                continue
-            labels.append(float(toks[0]))
-            row: List[Tuple[int, float]] = []
-            for t in toks[1:]:
-                i, v = t.split(":")
-                idx = int(i)
-                row.append((idx, float(v)))
-                max_idx = max(max_idx, idx)
-            rows.append(row)
-    mat = np.zeros((len(rows), max_idx + 1), dtype=np.float64)
-    for r, row in enumerate(rows):
-        for idx, v in row:
-            mat[r, idx] = v
-    return mat, np.asarray(labels, dtype=np.float32)
+    """Whole-file LibSVM load through the chunked reader (the block
+    parsers — native and python — live in data/reader.py now)."""
+    from ..data.reader import LibSVMChunkReader
+
+    return LibSVMChunkReader(path).read_all()

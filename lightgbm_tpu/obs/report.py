@@ -38,6 +38,7 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     retraces = 0
     peak_host = 0.0
     peak_dev = 0.0
+    ingest_done: Dict[str, Any] = {}
     for r in records:
         ev = r.get("ev")
         if ev == "span":
@@ -55,6 +56,9 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 compile_secs += float(r.get("secs", 0.0))
             elif name == "jax_retrace":
                 retraces += 1
+            elif name == "ingest.done":
+                ingest_done = {k: v for k, v in r.items()
+                               if k not in ("ev", "name", "ts")}
     phase_totals: Dict[str, Dict[str, float]] = {}
     for it in iters:
         for k, v in (it.get("phases") or {}).items():
@@ -83,6 +87,8 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "peak_host_rss_mb": round(peak_host, 1),
         "peak_dev_mb": round(peak_dev, 1),
     }
+    if ingest_done:
+        out["ingest"] = ingest_done
     if iters:
         last = iters[-1]
         out["last_iter"] = int(last.get("iter", -1))
@@ -128,6 +134,16 @@ def render(summary: Dict[str, Any], path: str = "") -> str:
         + (f", device {summary['peak_dev_mb']:.0f} MB"
            if summary["peak_dev_mb"] else "")
     )
+    ing = summary.get("ingest")
+    if ing:
+        lines.append(
+            "streaming ingest: "
+            f"{ing.get('rows', '?')} rows in {ing.get('wall_s', '?')} s "
+            f"({ing.get('rows_per_s', '?')} rows/s), "
+            f"{ing.get('chunks_pass2', '?')} chunks x {ing.get('chunk_rows', '?')} rows, "
+            f"packed {ing.get('packed_mb', '?')} MB, "
+            f"peak RSS {ing.get('rss_peak_mb', '?')} MB"
+        )
     return "\n".join(lines) + "\n"
 
 

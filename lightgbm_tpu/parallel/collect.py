@@ -1,0 +1,93 @@
+"""Host-level collective helpers for variable-length payloads.
+
+The reference's distributed find-bin allgathers serialized BinMappers
+with fixed-width copy buffers sized by an Allreduce'd max
+(dataset_loader.cpp:733-835).  Here every host-side merge (bin mappers,
+ingest statistics sketches) rides one code path with two transports:
+
+- device arrays via ``multihost_utils.process_allgather`` (length-
+  prefixed blobs padded to a gathered max) when the backend supports
+  multi-process computations;
+- the distributed-runtime key-value store (the same store
+  ``jax.distributed.initialize`` bootstraps from) on backends that do
+  not — XLA:CPU rejects multi-process programs outright, which is
+  exactly the multi-host ingest test environment.
+
+The transport is chosen deterministically from the backend name so
+every process takes the same branch (a mixed choice would deadlock).
+Single-process runs short-circuit without touching the backend.
+"""
+
+from __future__ import annotations
+
+import itertools
+import pickle
+from typing import List, Optional
+
+import numpy as np
+
+# per-process call counter: processes make collective calls in the same
+# program order, so the counter yields matching keys across ranks
+_kv_uid = itertools.count()
+
+
+def _kv_allgather(blob: bytes) -> List[bytes]:
+    import jax
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:
+        raise RuntimeError("distributed runtime not initialized")
+    rank = jax.process_index()
+    nproc = jax.process_count()
+    uid = next(_kv_uid)
+    client.key_value_set(f"ltpu_collect/{uid}/{rank}", blob.hex())
+    out = []
+    for r in range(nproc):
+        v = client.blocking_key_value_get(f"ltpu_collect/{uid}/{r}", 120_000)
+        out.append(bytes.fromhex(v))
+    return out
+
+
+def _array_allgather(blob: bytes) -> List[bytes]:
+    import jax
+    from jax.experimental import multihost_utils
+
+    gmax = int(np.max(multihost_utils.process_allgather(
+        np.asarray(len(blob), np.int64)
+    )))
+    buf = np.zeros(gmax + 8, np.uint8)
+    buf[:8] = np.frombuffer(len(blob).to_bytes(8, "little"), np.uint8)
+    buf[8 : 8 + len(blob)] = np.frombuffer(blob, np.uint8)
+    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    out = []
+    for r in range(gathered.shape[0]):
+        ln = int.from_bytes(gathered[r, :8].tobytes(), "little")
+        out.append(gathered[r, 8 : 8 + ln].tobytes())
+    return out
+
+
+def allgather_bytes(blob: bytes) -> List[bytes]:
+    """One blob per process -> every process's blob, in process order."""
+    import jax
+
+    if jax.process_count() == 1:
+        return [blob]
+    if jax.default_backend() == "cpu":
+        # XLA:CPU has no multi-process computations; use the KV store
+        return _kv_allgather(blob)
+    return _array_allgather(blob)
+
+
+def allgather_blob_lists(
+    blobs: List[bytes], list_len: Optional[int] = None
+) -> List[List[bytes]]:
+    """Gather each process's list of byte blobs; returns one list per
+    process, in process order.  ``list_len`` pads every process's list
+    to a common length (callers that index a fixed feature-block shape
+    — e.g. the last find-bin block being short); padded slots come back
+    as empty blobs."""
+    pad = list_len if list_len is not None else len(blobs)
+    payload = pickle.dumps(list(blobs) + [b""] * (pad - len(blobs)),
+                           protocol=pickle.HIGHEST_PROTOCOL)
+    return [pickle.loads(p) for p in allgather_bytes(payload)]

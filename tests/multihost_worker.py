@@ -41,6 +41,33 @@ from lightgbm_tpu.ops.grow import GrowParams  # noqa: E402
 from lightgbm_tpu.ops.split import FeatureMeta, SplitHyper  # noqa: E402
 from lightgbm_tpu.parallel import ShardedLearner, make_mesh  # noqa: E402
 
+if mode == "sketchmerge":
+    # streaming-ingest sketch merge across hosts: each rank folds a
+    # DIFFERENT row half into its sketch bank chunk-by-chunk, then
+    # merge_across_hosts allgathers + merges.  Exact (unspilled)
+    # sketches must come back bit-identical to a single-process sketch
+    # of the full data, on BOTH ranks.
+    import pickle
+
+    from lightgbm_tpu.data.stats import SketchCollector
+
+    rng = np.random.default_rng(17)
+    X = rng.integers(-4, 9, size=(6000, 5)).astype(np.float64)
+    X[rng.random((6000, 5)) < 0.05] = np.nan
+    half = X[:3000] if rank == 0 else X[3000:]
+    coll = SketchCollector(categorical={4}, cap=100_000)
+    for lo in range(0, 3000, 700):
+        coll.update(half[lo : lo + 700])
+    coll.merge_across_hosts()
+    if rank == 0:
+        banks = [sk.to_distinct_counts() for sk in coll.sketches]
+        extras = [(sk.total_cnt, getattr(sk, "zero_cnt", -1),
+                   getattr(sk, "nan_cnt", -1)) for sk in coll.sketches]
+        with open(out, "wb") as fh:
+            pickle.dump({"banks": banks, "extras": extras}, fh)
+    print(f"rank {rank} sketchmerge done: {coll.rows_seen} rows")
+    sys.exit(0)
+
 if mode == "findbin":
     # distributed find-bin parity: both ranks hold the SAME data; the
     # feature mappers (each found by exactly one rank, then allgathered)

@@ -1,0 +1,515 @@
+"""Out-of-core streaming ingest (lightgbm_tpu/data/).
+
+Acceptance contract (ISSUE 3): a Dataset streamed in chunks is
+bit-identical to the in-memory construction of the same file — BinMapper
+bounds, packed bin matrix, and the trained model string — under the same
+bin_construct_sample_cnt sample.  Tier-1 runs the small-chunk
+(chunk_rows~1k) configuration; the multi-GB stress lives behind the
+``slow`` marker.
+"""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.basic import Dataset
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.data.ingest import should_stream, stream_dataset
+from lightgbm_tpu.data.reader import DenseChunkReader, LibSVMChunkReader
+from lightgbm_tpu.data.sketch import (
+    CategoricalSketch,
+    GKSketch,
+    NumericSketch,
+    merge_sketch_lists,
+)
+from lightgbm_tpu.data.stats import (
+    SampleCollector,
+    SketchCollector,
+    mappers_from_sketches,
+)
+from lightgbm_tpu.io.binning import BinMapper
+from lightgbm_tpu.io.parser import load_text_file
+
+
+# ----------------------------------------------------------------------
+# file fixtures
+# ----------------------------------------------------------------------
+def _write_csv(path, X, y, header=False, weight=None, gid=None, fmt="%.8g"):
+    cols = [np.asarray(y, np.float64)]
+    names = ["lab"]
+    if weight is not None:
+        cols.append(np.asarray(weight, np.float64))
+        names.append("wt")
+    if gid is not None:
+        cols.append(np.asarray(gid, np.float64))
+        names.append("qid")
+    for i in range(X.shape[1]):
+        cols.append(np.asarray(X[:, i], np.float64))
+        names.append(f"f{i}")
+    mat = np.column_stack(cols)
+    with open(path, "w") as f:
+        if header:
+            f.write(",".join(names) + "\n")
+        for row in mat:
+            f.write(",".join("" if np.isnan(v) else (fmt % v) for v in row) + "\n")
+    return names
+
+
+def _binary_problem(n=5000, f=6, seed=0, with_nan=True):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    X[:, f - 1] = rng.randint(0, 7, n)  # low-cardinality / tie-heavy
+    X[rng.rand(n) < 0.02, 1] = 0.0      # exact zeros hit the zero-bin path
+    if with_nan:
+        X[rng.rand(n) < 0.03, 2] = np.nan
+    w = rng.randn(f)
+    logits = np.nansum(X[:, :4] * w[:4], axis=1)
+    y = (rng.rand(n) < 1 / (1 + np.exp(-logits))).astype(np.float32)
+    return X, y
+
+
+def _assert_mappers_equal(a, b):
+    assert len(a) == len(b)
+    for ma, mb in zip(a, b):
+        assert ma.num_bin == mb.num_bin
+        assert ma.bin_type == mb.bin_type
+        assert ma.is_trivial == mb.is_trivial
+        assert ma.default_bin == mb.default_bin
+        np.testing.assert_array_equal(ma.bin_upper_bound, mb.bin_upper_bound)
+        np.testing.assert_array_equal(ma.bin_2_categorical, mb.bin_2_categorical)
+
+
+# ----------------------------------------------------------------------
+# chunked readers
+# ----------------------------------------------------------------------
+class TestChunkedReader:
+    def test_chunk_boundaries_do_not_change_values(self, tmp_path):
+        X, y = _binary_problem(n=2000)
+        p = str(tmp_path / "d.csv")
+        _write_csv(p, X, y)
+        one = DenseChunkReader(p, ",", False, chunk_rows=10**9).read_all()[0]
+        chunks = list(DenseChunkReader(p, ",", False, chunk_rows=137).iter_chunks())
+        assert len(chunks) == -(-2000 // 137)
+        many = np.vstack([c for _, c in chunks])
+        np.testing.assert_array_equal(one, many)
+        starts = [s for s, _ in chunks]
+        assert starts == [i * 137 for i in range(len(chunks))]
+
+    def test_count_rows_skips_blank_and_header(self, tmp_path):
+        p = tmp_path / "d.csv"
+        p.write_text("a,b\n1,2\n\n3,4\n   \n5,6\n")
+        r = DenseChunkReader(str(p), ",", True)
+        assert r.count_rows() == 3
+        assert r.header_names == ["a", "b"]
+        mat, names = r.read_all()
+        np.testing.assert_array_equal(mat, [[1, 2], [3, 4], [5, 6]])
+
+    def test_libsvm_width_grows_across_chunks(self, tmp_path):
+        p = tmp_path / "d.svm"
+        # later lines reference higher feature indices than earlier ones
+        p.write_text("1 0:1.5\n0 1:2.5\n1 4:3.5\n0 2:0.5\n")
+        r = LibSVMChunkReader(str(p), chunk_rows=2)
+        feats, labels = r.read_all()
+        assert feats.shape == (4, 5)
+        assert r.ncols_seen == 5
+        np.testing.assert_array_equal(labels, [1, 0, 1, 0])
+        assert feats[2, 4] == 3.5 and feats[0, 0] == 1.5
+
+
+# ----------------------------------------------------------------------
+# sketches
+# ----------------------------------------------------------------------
+class TestSketches:
+    def test_numeric_exact_matches_unique(self):
+        rng = np.random.RandomState(1)
+        col = rng.randint(0, 50, 3000).astype(np.float64)
+        sk = NumericSketch(cap=1000)
+        for lo in range(0, 3000, 250):
+            sk.update(col[lo : lo + 250])
+        assert not sk.spilled
+        vals, cnts = sk.to_distinct_counts()
+        ref = col[col != 0.0]
+        rv, rc = np.unique(ref, return_counts=True)
+        np.testing.assert_array_equal(vals, rv)
+        np.testing.assert_array_equal(cnts, rc)
+        assert sk.zero_cnt == int((col == 0.0).sum())
+        assert sk.total_cnt == 3000
+
+    def test_numeric_merge_order_independent_exact(self):
+        rng = np.random.RandomState(2)
+        cols = [rng.randint(0, 30, 500).astype(np.float64) for _ in range(4)]
+        def build(order):
+            sks = []
+            for c in order:
+                s = NumericSketch(cap=10_000)
+                s.update(c)
+                sks.append([s])
+            return merge_sketch_lists(sks)[0].to_distinct_counts()
+        v1, c1 = build(cols)
+        v2, c2 = build(cols[::-1])
+        np.testing.assert_array_equal(v1, v2)
+        np.testing.assert_array_equal(c1, c2)
+
+    def test_numeric_spill_bounds_memory_and_rank_error(self):
+        rng = np.random.RandomState(3)
+        n = 60_000
+        col = rng.randn(n)
+        sk = NumericSketch(cap=512, eps=0.01)
+        for lo in range(0, n, 5000):
+            sk.update(col[lo : lo + 5000])
+        assert sk.spilled
+        # summary stays small
+        assert len(sk.gk.vals) < 5000
+        vals, cnts = sk.to_distinct_counts()
+        assert int(cnts.sum()) == n  # no mass lost
+        # rank error of the implied CDF within a few eps*n
+        order = np.sort(col)
+        for q in (0.1, 0.25, 0.5, 0.75, 0.9):
+            est = sk.gk.quantile(q)
+            true_rank = np.searchsorted(order, est) / n
+            assert abs(true_rank - q) < 5 * 0.01, (q, est, true_rank)
+
+    def test_gk_merge_mass_conserved(self):
+        rng = np.random.RandomState(4)
+        a, b = GKSketch(eps=0.02), GKSketch(eps=0.02)
+        xa, xb = rng.randn(5000), rng.randn(7000) + 1.0
+        va, ca = np.unique(xa, return_counts=True)
+        vb, cb = np.unique(xb, return_counts=True)
+        a.insert_batch(va, ca)
+        b.insert_batch(vb, cb)
+        a.merge(b)
+        assert a.n == 12000
+        _, g = a.to_distinct_counts()
+        assert int(g.sum()) == 12000
+        med = a.quantile(0.5)
+        true = np.median(np.concatenate([xa, xb]))
+        order = np.sort(np.concatenate([xa, xb]))
+        rank = np.searchsorted(order, med) / 12000
+        assert abs(rank - 0.5) < 0.1, (med, true)
+
+    def test_categorical_exact_and_mg_undercount_bound(self):
+        rng = np.random.RandomState(5)
+        col = rng.zipf(1.5, 5000).astype(np.float64)
+        col[col > 1000] = 1000
+        sk = CategoricalSketch(cap=32)
+        for lo in range(0, 5000, 500):
+            sk.update(col[lo : lo + 500])
+        vals, cnts = sk.to_distinct_counts()
+        true = {int(v): int(c) for v, c in
+                zip(*np.unique(col.astype(np.int64), return_counts=True))}
+        # Misra-Gries: surviving counters undercount by at most `error`
+        for v, c in zip(vals.astype(np.int64), cnts):
+            assert true[int(v)] >= c
+            assert true[int(v)] - c <= sk.error
+
+    def test_exact_sketch_mappers_bit_identical_to_find_bin(self):
+        rng = np.random.RandomState(6)
+        X = np.column_stack([
+            rng.randn(4000),
+            rng.randint(0, 40, 4000).astype(np.float64),
+            np.where(rng.rand(4000) < 0.3, 0.0, rng.randn(4000)),
+        ])
+        cfg = Config.from_params({"max_bin": 63, "min_data_in_leaf": 1})
+        coll = SketchCollector(cap=100_000)
+        for lo in range(0, 4000, 333):
+            coll.update(X[lo : lo + 333])
+        sk_mappers = mappers_from_sketches(coll, 4000, cfg)
+        direct = []
+        for f in range(X.shape[1]):
+            col = X[:, f]
+            col = col[~np.isnan(col)]
+            m = BinMapper()
+            m.find_bin(col[col != 0.0], 4000, cfg.max_bin,
+                       cfg.min_data_in_bin, cfg.min_data_in_leaf)
+            direct.append(m)
+        _assert_mappers_equal(sk_mappers, direct)
+
+    def test_sample_collector_matches_fancy_indexing(self):
+        rng = np.random.RandomState(7)
+        data = rng.randn(1000, 4)
+        idx = np.sort(rng.choice(1000, 200, replace=False))
+        c = SampleCollector(idx, ncols=4)
+        for lo in range(0, 1000, 90):
+            c.offer(lo, data[lo : lo + 90])
+        np.testing.assert_array_equal(c.finish(), data[idx])
+
+
+# ----------------------------------------------------------------------
+# streaming <-> in-memory parity (the tier-1 small-chunk configuration)
+# ----------------------------------------------------------------------
+class TestStreamingParity:
+    def _construct_both(self, path, params=None, chunk_rows=1000, **dskw):
+        cfg_params = dict(params or {})
+        os.environ.pop("LIGHTGBM_TPU_STREAM_CHUNK_ROWS", None)
+        os.environ["LIGHTGBM_TPU_STREAM_INGEST"] = "0"
+        try:
+            mem = Dataset(path, params=dict(cfg_params), **dskw).construct()
+        finally:
+            os.environ.pop("LIGHTGBM_TPU_STREAM_INGEST", None)
+        os.environ["LIGHTGBM_TPU_STREAM_INGEST"] = "1"
+        os.environ["LIGHTGBM_TPU_STREAM_CHUNK_ROWS"] = str(chunk_rows)
+        try:
+            stream = Dataset(path, params=dict(cfg_params), **dskw).construct()
+        finally:
+            os.environ.pop("LIGHTGBM_TPU_STREAM_INGEST", None)
+            os.environ.pop("LIGHTGBM_TPU_STREAM_CHUNK_ROWS", None)
+        return mem, stream
+
+    def test_csv_bit_identical(self, tmp_path):
+        X, y = _binary_problem()
+        p = str(tmp_path / "d.csv")
+        _write_csv(p, X, y)
+        mem, stream = self._construct_both(p, {"max_bin": 63})
+        assert getattr(stream, "ingest_report", {}).get("chunks_pass2", 0) > 3
+        _assert_mappers_equal(mem.bin_mappers, stream.bin_mappers)
+        np.testing.assert_array_equal(mem.used_feature_map, stream.used_feature_map)
+        np.testing.assert_array_equal(mem.binned, stream.binned)
+        np.testing.assert_array_equal(mem.metadata.label, stream.metadata.label)
+        assert mem.feature_names == stream.feature_names
+        assert mem.num_total_features == stream.num_total_features
+
+    def test_header_weight_group_columns(self, tmp_path):
+        X, y = _binary_problem(n=3000)
+        rng = np.random.RandomState(8)
+        w = rng.rand(3000) + 0.5
+        gid = np.sort(rng.randint(0, 50, 3000))
+        p = str(tmp_path / "d.csv")
+        _write_csv(p, X, y, header=True, weight=w, gid=gid)
+        params = {"has_header": True, "label_column": "name:lab",
+                  "weight_column": "name:wt", "group_column": "name:qid"}
+        mem, stream = self._construct_both(p, params)
+        np.testing.assert_array_equal(mem.binned, stream.binned)
+        np.testing.assert_array_equal(mem.metadata.label, stream.metadata.label)
+        np.testing.assert_array_equal(mem.metadata.weights, stream.metadata.weights)
+        np.testing.assert_array_equal(
+            mem.metadata.query_boundaries, stream.metadata.query_boundaries
+        )
+        assert mem.feature_names == stream.feature_names
+
+    def test_side_files(self, tmp_path):
+        X, y = _binary_problem(n=1500)
+        p = str(tmp_path / "d.csv")
+        _write_csv(p, X, y)
+        rng = np.random.RandomState(9)
+        np.savetxt(p + ".weight", rng.rand(1500) + 0.5, fmt="%.6g")
+        with open(p + ".query", "w") as f:
+            f.write("700\n800\n")
+        mem, stream = self._construct_both(p)
+        np.testing.assert_array_equal(mem.metadata.weights, stream.metadata.weights)
+        np.testing.assert_array_equal(
+            mem.metadata.query_boundaries, stream.metadata.query_boundaries
+        )
+
+    def test_libsvm_bit_identical(self, tmp_path):
+        rng = np.random.RandomState(10)
+        p = str(tmp_path / "d.svm")
+        with open(p, "w") as f:
+            for i in range(2500):
+                y = rng.randint(0, 2)
+                nnz = rng.randint(1, 6)
+                idx = np.sort(rng.choice(12, nnz, replace=False))
+                pairs = " ".join(f"{j}:{rng.randn():.6g}" for j in idx)
+                f.write(f"{y} {pairs}\n")
+        mem, stream = self._construct_both(p, {"max_bin": 31}, chunk_rows=200)
+        _assert_mappers_equal(mem.bin_mappers, stream.bin_mappers)
+        np.testing.assert_array_equal(mem.binned, stream.binned)
+        np.testing.assert_array_equal(mem.metadata.label, stream.metadata.label)
+
+    def test_trained_model_hash_identical_50k(self, tmp_path):
+        """The end-to-end acceptance check: the model TRAINED from a
+        streamed ~50k-row dataset is byte-identical to one trained from
+        the in-memory load of the same file."""
+        X, y = _binary_problem(n=50_000, f=8, seed=11)
+        p = str(tmp_path / "big.csv")
+        _write_csv(p, X, y)
+        params = {"objective": "binary", "num_leaves": 31, "max_bin": 63,
+                  "learning_rate": 0.1, "min_data_in_leaf": 20, "verbose": -1}
+        hashes = {}
+        for mode, chunk in (("0", None), ("1", 4096)):
+            os.environ["LIGHTGBM_TPU_STREAM_INGEST"] = mode
+            if chunk:
+                os.environ["LIGHTGBM_TPU_STREAM_CHUNK_ROWS"] = str(chunk)
+            try:
+                ds = lgb.Dataset(p, params=dict(params))
+                bst = lgb.train(dict(params), ds, num_boost_round=5)
+                hashes[mode] = hashlib.sha256(
+                    bst.model_to_string().encode()
+                ).hexdigest()
+            finally:
+                os.environ.pop("LIGHTGBM_TPU_STREAM_INGEST", None)
+                os.environ.pop("LIGHTGBM_TPU_STREAM_CHUNK_ROWS", None)
+        assert hashes["0"] == hashes["1"]
+
+    def test_valid_set_streams_with_reference_mappers(self, tmp_path):
+        X, y = _binary_problem(n=4000, seed=12)
+        ptr = str(tmp_path / "train.csv")
+        pva = str(tmp_path / "valid.csv")
+        _write_csv(ptr, X[:3000], y[:3000])
+        _write_csv(pva, X[3000:], y[3000:])
+        params = {"objective": "binary", "max_bin": 63, "verbose": -1}
+        os.environ["LIGHTGBM_TPU_STREAM_INGEST"] = "1"
+        os.environ["LIGHTGBM_TPU_STREAM_CHUNK_ROWS"] = "500"
+        try:
+            dtr = lgb.Dataset(ptr, params=dict(params))
+            dva = dtr.create_valid(pva)
+            binned_tr = dtr.construct()
+            binned_va = dva.construct()
+        finally:
+            os.environ.pop("LIGHTGBM_TPU_STREAM_INGEST", None)
+            os.environ.pop("LIGHTGBM_TPU_STREAM_CHUNK_ROWS", None)
+        # valid set must share the TRAIN mappers (CreateValid contract)
+        assert binned_va.bin_mappers is binned_tr.bin_mappers
+        # and bin with them exactly like the in-memory align path
+        os.environ["LIGHTGBM_TPU_STREAM_INGEST"] = "0"
+        try:
+            dtr2 = lgb.Dataset(ptr, params=dict(params))
+            ref = dtr2.construct()
+            va2 = dtr2.create_valid(pva).construct()
+        finally:
+            os.environ.pop("LIGHTGBM_TPU_STREAM_INGEST", None)
+        np.testing.assert_array_equal(binned_va.binned, va2.binned)
+
+    def test_raw_matrix_not_materialized(self, tmp_path):
+        """The Dataset object never holds the raw float matrix on the
+        streaming path (peak-memory contract; the full-scale RSS bound
+        is asserted in the slow test / bench ingest section)."""
+        X, y = _binary_problem(n=2000)
+        p = str(tmp_path / "d.csv")
+        _write_csv(p, X, y)
+        os.environ["LIGHTGBM_TPU_STREAM_INGEST"] = "1"
+        try:
+            d = Dataset(p)
+            binned = d.construct()
+        finally:
+            os.environ.pop("LIGHTGBM_TPU_STREAM_INGEST", None)
+        assert d.data is None
+        assert binned.ingest_report["streamed"] is True
+
+
+# ----------------------------------------------------------------------
+# routing / gating
+# ----------------------------------------------------------------------
+class TestShouldStream:
+    def test_env_forces(self, tmp_path, monkeypatch):
+        p = tmp_path / "d.csv"
+        p.write_text("1,2\n3,4\n")
+        cfg = Config()
+        monkeypatch.setenv("LIGHTGBM_TPU_STREAM_INGEST", "1")
+        assert should_stream(str(p), cfg)
+        monkeypatch.setenv("LIGHTGBM_TPU_STREAM_INGEST", "0")
+        assert not should_stream(str(p), cfg)
+
+    def test_auto_threshold(self, tmp_path, monkeypatch):
+        p = tmp_path / "d.csv"
+        p.write_text("1,2\n" * 4000)  # ~16 KB
+        cfg = Config()
+        monkeypatch.delenv("LIGHTGBM_TPU_STREAM_INGEST", raising=False)
+        assert not should_stream(str(p), cfg)  # far below auto threshold
+        monkeypatch.setenv("LIGHTGBM_TPU_STREAM_INGEST", "0.001")  # 1 KB
+        assert should_stream(str(p), cfg)
+
+    def test_two_round_loading_forces_streaming(self, tmp_path, monkeypatch):
+        p = tmp_path / "d.csv"
+        p.write_text("1,2\n3,4\n")
+        monkeypatch.delenv("LIGHTGBM_TPU_STREAM_INGEST", raising=False)
+        cfg = Config.from_params({"use_two_round_loading": True})
+        assert should_stream(str(p), cfg)
+
+    def test_config_param_surface(self, tmp_path, monkeypatch):
+        p = tmp_path / "d.csv"
+        p.write_text("1,2\n3,4\n")
+        monkeypatch.delenv("LIGHTGBM_TPU_STREAM_INGEST", raising=False)
+        cfg = Config.from_params({"stream_ingest": "true"})
+        assert should_stream(str(p), cfg)
+        cfg = Config.from_params({"stream_ingest": "false",
+                                  "use_two_round_loading": True})
+        assert not should_stream(str(p), cfg)
+
+
+class TestIngestCLI:
+    def test_task_ingest_writes_loadable_binary(self, tmp_path, monkeypatch):
+        from lightgbm_tpu.cli import main as cli_main
+        from lightgbm_tpu.io.dataset import BinnedDataset
+
+        X, y = _binary_problem(n=1200)
+        p = str(tmp_path / "d.csv")
+        _write_csv(p, X, y)
+        monkeypatch.setenv("LIGHTGBM_TPU_STREAM_CHUNK_ROWS", "250")
+        assert cli_main(["task=ingest", f"data={p}", "max_bin=63"]) == 0
+        cache = p + ".bin"
+        assert BinnedDataset.is_binary_cache(cache)
+        ds = Dataset(cache).construct()
+        os.environ["LIGHTGBM_TPU_STREAM_INGEST"] = "0"
+        try:
+            ref = Dataset(p, params={"max_bin": 63}).construct()
+        finally:
+            os.environ.pop("LIGHTGBM_TPU_STREAM_INGEST", None)
+        np.testing.assert_array_equal(ds.binned, ref.binned)
+        np.testing.assert_array_equal(ds.metadata.label, ref.metadata.label)
+
+    def test_ingest_trace_records(self, tmp_path, monkeypatch):
+        """Ingest spans/counters/gauges land in the obs trace and the
+        report CLI surfaces the ingest section."""
+        from lightgbm_tpu.obs import tracer
+        from lightgbm_tpu.obs.report import load_trace, summarize
+
+        X, y = _binary_problem(n=1500)
+        p = str(tmp_path / "d.csv")
+        _write_csv(p, X, y)
+        trace = str(tmp_path / "trace.jsonl")
+        tracer.configure(trace)
+        try:
+            stream_dataset(p, Config(), chunk_rows=300)
+        finally:
+            tracer.close()
+            tracer.path = None
+        records = load_trace(trace)
+        spans = {r["name"] for r in records if r.get("ev") == "span"}
+        assert {"ingest.pass0_count", "ingest.pass1_stats",
+                "ingest.find_bin", "ingest.pass2_bin"} <= spans
+        assert any(r.get("ev") == "counter" and r["name"] == "ingest.chunks"
+                   for r in records)
+        assert any(r.get("ev") == "gauge" and r["name"] == "ingest.host_rss_mb"
+                   for r in records)
+        summary = summarize(records)
+        assert summary["ingest"]["rows"] == 1500
+        assert summary["ingest"]["chunks_pass2"] == 5
+
+
+# ----------------------------------------------------------------------
+# multi-GB stress: out of tier-1 (slow marker)
+# ----------------------------------------------------------------------
+@pytest.mark.slow
+def test_large_ingest_memory_bound(tmp_path):
+    """Streaming a large synthetic file keeps peak RSS near the packed
+    matrix + O(chunk) — the raw float matrix (8x larger) never exists.
+    SLOW_INGEST_ROWS=10500000 reproduces the Higgs-scale entry."""
+    rows = int(os.environ.get("SLOW_INGEST_ROWS", 2_000_000))
+    f = 28
+    rng = np.random.RandomState(0)
+    p = str(tmp_path / "big.csv")
+    with open(p, "w") as fh:
+        for lo in range(0, rows, 100_000):
+            k = min(100_000, rows - lo)
+            X = rng.randn(k, f).astype(np.float32)
+            y = (rng.rand(k) < 0.5).astype(np.float32)
+            block = np.column_stack([y, X])
+            fh.write("\n".join(
+                ",".join("%.6g" % v for v in r) for r in block
+            ) + "\n")
+    os.environ["LIGHTGBM_TPU_STREAM_INGEST"] = "1"
+    try:
+        ds = Dataset(p, params={"max_bin": 63}).construct()
+    finally:
+        os.environ.pop("LIGHTGBM_TPU_STREAM_INGEST", None)
+    rep = ds.ingest_report
+    assert rep["rows"] == rows
+    chunk_raw_mb = rep["chunk_rows"] * (f + 1) * 8 / 1e6
+    raw_mb = rows * (f + 1) * 8 / 1e6
+    increase = rep["rss_peak_mb"] - rep["rss_start_mb"]
+    bound = rep["packed_mb"] + 8 * chunk_raw_mb + 256
+    assert increase <= bound, (increase, bound)
+    assert bound < raw_mb  # the bound itself rules out the raw matrix

@@ -195,3 +195,52 @@ def test_two_process_distributed_find_bin_bit_identical(tmp_path):
             np.testing.assert_array_equal(np.asarray(sg[k]), np.asarray(sr[k]), err_msg=k)
     np.testing.assert_array_equal(got["binned"], ref.binned)
     np.testing.assert_array_equal(got["used"], ref.used_feature_map)
+
+
+@pytest.mark.slow
+def test_two_process_sketch_merge_bit_identical(tmp_path):
+    """Streaming-ingest sketch banks merged across two hosts
+    (parallel/collect.py allgather, the ingest mirror of distributed
+    find-bin) equal a single-process sketch of the full data exactly
+    while unspilled."""
+    import pickle
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(here, "multihost_worker.py")
+    out = str(tmp_path / "sketch0.pkl")
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(r), str(port), out, "sketchmerge"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        )
+        for r in (0, 1)
+    ]
+    logs = []
+    for p in procs:
+        o, _ = p.communicate(timeout=600)
+        logs.append(o.decode())
+    assert all(p.returncode == 0 for p in procs), "\n".join(logs)
+    with open(out, "rb") as fh:
+        got = pickle.load(fh)
+
+    from lightgbm_tpu.data.stats import SketchCollector
+
+    rng = np.random.default_rng(17)
+    X = rng.integers(-4, 9, size=(6000, 5)).astype(np.float64)
+    X[rng.random((6000, 5)) < 0.05] = np.nan
+    ref = SketchCollector(categorical={4}, cap=100_000)
+    for lo in range(0, 6000, 700):
+        ref.update(X[lo : lo + 700])
+    assert len(got["banks"]) == len(ref.sketches) == 5
+    for (gv, gc), sk, (tot, zc, nc) in zip(
+        got["banks"], ref.sketches, got["extras"]
+    ):
+        rv, rc = sk.to_distinct_counts()
+        np.testing.assert_array_equal(gv, rv)
+        np.testing.assert_array_equal(gc, rc)
+        assert tot == sk.total_cnt
+        assert zc == getattr(sk, "zero_cnt", -1)
+        assert nc == getattr(sk, "nan_cnt", -1)
