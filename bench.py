@@ -180,6 +180,64 @@ def _bench_ingest(X, y, n_rows):
     return section
 
 
+def _bench_checkpoint(X, y, base_params):
+    """Checkpoint subsystem benchmark (docs/CHECKPOINT.md): save latency
+    p50/p99, checkpoint bytes, and the per-iteration overhead of
+    background-write checkpointing at freq in {0, 10, 1} on the standard
+    bench config (acceptance: freq=10 overhead < 5%).  BENCH_CKPT=0
+    skips; BENCH_CKPT_ROWS / BENCH_CKPT_ITERS resize."""
+    import shutil
+    import tempfile
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.ckpt import CheckpointManager
+
+    section = {}
+    rows = min(int(os.environ.get("BENCH_CKPT_ROWS", 200_000)), len(X))
+    iters = int(os.environ.get("BENCH_CKPT_ITERS", 30))
+    Xb, yb = X[:rows], y[:rows]
+    try:
+        # warmup run compiles the train programs so the freq=0 baseline
+        # isn't charged for compilation the other configs then reuse
+        lgb.train(dict(base_params), lgb.Dataset(Xb, label=yb,
+                  params=dict(base_params)), 3, verbose_eval=False)
+        times = {}
+        stats10 = None
+        for freq in (0, 10, 1):
+            d = tempfile.mkdtemp(prefix="bench_ckpt_")
+            mgr = CheckpointManager(d, freq=freq) if freq > 0 else None
+            ds = lgb.Dataset(Xb, label=yb, params=dict(base_params))
+            t0 = time.time()
+            lgb.train(dict(base_params), ds, iters, verbose_eval=False,
+                      checkpoint_manager=mgr)
+            times[freq] = time.time() - t0
+            if mgr is not None:
+                mgr.close()
+                if freq == 10:
+                    stats10 = dict(mgr.stats)
+            shutil.rmtree(d, ignore_errors=True)
+        base = max(times[0], 1e-9)
+        section = {
+            "rows": rows,
+            "iters": iters,
+            "total_s": {f"freq{k}": round(v, 3) for k, v in times.items()},
+            "overhead_freq10_pct": round(100.0 * (times[10] - base) / base, 2),
+            "overhead_freq1_pct": round(100.0 * (times[1] - base) / base, 2),
+        }
+        if stats10:
+            lat = sorted(stats10["save_s"])
+            if lat:
+                section["save_p50_ms"] = round(1e3 * lat[len(lat) // 2], 2)
+                section["save_p99_ms"] = round(
+                    1e3 * lat[min(len(lat) - 1, int(0.99 * len(lat)))], 2
+                )
+            section["ckpt_bytes"] = stats10["bytes"]
+            section["saves_freq10"] = stats10["saves"]
+    except Exception as e:  # pragma: no cover — ckpt must not kill bench
+        section["error"] = f"{type(e).__name__}: {e}"
+    return section
+
+
 def _auc(y, s):
     """AUC via the library's own metric (one implementation to trust)."""
     from lightgbm_tpu.config import Config
@@ -470,6 +528,11 @@ def main():
     # BENCH_ROWS=10500000 this is the Higgs-scale ingest entry.
     if os.environ.get("BENCH_INGEST", "1") != "0":
         out["ingest"] = _bench_ingest(X, y, n_rows)
+
+    # checkpoint section (docs/CHECKPOINT.md): save latency + the
+    # per-iteration cost of fault tolerance at freq 0/10/1
+    if os.environ.get("BENCH_CKPT", "1") != "0":
+        out["checkpoint"] = _bench_checkpoint(X, y, params)
 
     # run-trace embedding (docs/OBSERVABILITY.md): the per-phase span
     # totals and compile accounting gathered during THIS run, so the

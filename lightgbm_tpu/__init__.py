@@ -81,6 +81,7 @@ _honor_jax_platforms_env()
 from .basic import Booster, Dataset
 from .engine import cv, train
 from .callback import early_stopping, log_evaluation, record_evaluation, reset_parameter
+from .ckpt import CheckpointManager
 from .utils.log import LightGBMError
 
 try:  # sklearn wrappers are optional (sklearn is present in CI images)
@@ -102,6 +103,7 @@ __all__ = [
     "LightGBMError",
     "train",
     "cv",
+    "CheckpointManager",
     "LGBMModel",
     "LGBMRegressor",
     "LGBMClassifier",

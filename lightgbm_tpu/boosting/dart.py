@@ -123,5 +123,27 @@ class DART(GBDT):
     def _add_tree_to_valid(self, tree, tree_id):
         self._add_tree_to_valid_scores(tree, tree_id)
 
+    # ------------------------------------------------------------------
+    def export_train_state(self):
+        """Checkpoint hook: DART's per-iteration drop decisions come
+        from a stateful LCG (``random_for_drop``) and the accumulated
+        tree-weight ledger — none of which the model text can carry."""
+        arrays, py = super().export_train_state()
+        py["dart"] = {
+            "drop_rng": self.random_for_drop.get_state(),
+            "tree_weight": [float(w) for w in self.tree_weight],
+            "sum_weight": float(self.sum_weight),
+        }
+        return arrays, py
+
+    def import_train_state(self, arrays, py) -> None:
+        super().import_train_state(arrays, py)
+        st = py["dart"]
+        self.random_for_drop.set_state(st["drop_rng"])
+        self.tree_weight = [float(w) for w in st["tree_weight"]]
+        self.sum_weight = float(st["sum_weight"])
+        self.drop_index = []
+        self.is_update_score_cur_iter = False
+
     def sub_model_name(self) -> str:
         return "tree"

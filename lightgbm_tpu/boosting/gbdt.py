@@ -665,6 +665,74 @@ class GBDT:
                 out.append((name, val, m.bigger_is_better))
         return out
 
+    # ------------------------------------------------------------------
+    def export_train_state(self):
+        """Checkpoint hook (ckpt/state.py): everything beyond the
+        config/dataset/trees that the next iteration reads — score
+        caches, the bagging/feature RNG streams, the live bagging mask,
+        early-stopping bests.  Subclasses extend via super().
+
+        Returns ``(arrays, py)``: numpy arrays for the npz payload and a
+        JSON-serializable dict."""
+        arrays = {
+            "scores": np.asarray(self.scores, np.float32),
+            "select": np.asarray(self.select, np.float32),
+        }
+        for i, vs in enumerate(self.valid_scores):
+            arrays[f"valid_scores_{i}"] = np.asarray(vs, np.float32)
+        st = self.bag_rng.get_state()
+        arrays["bag_rng_keys"] = np.asarray(st[1], np.uint32)
+        py = {
+            "iter": int(self.iter),
+            "num_init_iteration": int(self.num_init_iteration),
+            "boost_from_average": bool(self.boost_from_average_),
+            "shrinkage_rate": float(self.shrinkage_rate),
+            "bag_rng": [str(st[0]), int(st[2]), int(st[3]), float(st[4])],
+            "feature_rng": self.feature_rng.get_state(),
+            "need_re_bagging": bool(self.need_re_bagging),
+            "best_iter": [list(b) for b in self.best_iter],
+            "best_score": [list(b) for b in self.best_score],
+            "best_msg": [list(b) for b in self.best_msg],
+            "class_need_train": list(self.class_need_train),
+            "class_default_output": list(self.class_default_output),
+        }
+        if self.ptrainer is not None:
+            arrays["pt_rowid"] = self.ptrainer.export_perm()
+        return arrays, py
+
+    def import_train_state(self, arrays, py) -> None:
+        """Inverse of :meth:`export_train_state`; ``self.models`` is
+        restored by the caller (ckpt/state.py unpacks the tree arrays)
+        before this runs."""
+        self.iter = int(py["iter"])
+        self.num_init_iteration = int(py["num_init_iteration"])
+        self.boost_from_average_ = bool(py["boost_from_average"])
+        self.shrinkage_rate = float(py["shrinkage_rate"])
+        self.scores = jnp.asarray(np.asarray(arrays["scores"], np.float32))
+        self.select = jnp.asarray(np.asarray(arrays["select"], np.float32))
+        for i in range(len(self.valid_scores)):
+            self.valid_scores[i] = jnp.asarray(
+                np.asarray(arrays[f"valid_scores_{i}"], np.float32)
+            )
+        name, pos, has_gauss, cached = py["bag_rng"]
+        self.bag_rng.set_state(
+            (str(name), np.asarray(arrays["bag_rng_keys"], np.uint32),
+             int(pos), int(has_gauss), float(cached))
+        )
+        self.feature_rng.set_state(py["feature_rng"])
+        self.need_re_bagging = bool(py["need_re_bagging"])
+        self.best_iter = [list(map(int, b)) for b in py["best_iter"]]
+        self.best_score = [list(map(float, b)) for b in py["best_score"]]
+        self.best_msg = [list(map(str, b)) for b in py["best_msg"]]
+        self.class_need_train = list(py["class_need_train"])
+        self.class_default_output = list(py["class_default_output"])
+        if self.ptrainer is not None:
+            if "pt_rowid" in arrays:
+                self.ptrainer.import_perm(np.asarray(arrays["pt_rowid"]))
+            # score channels re-sync from the restored original-order
+            # scores at the next chunk (exact: channels are zero here)
+            self.ptrainer.score_dirty = True
+
     def refresh_config(self) -> None:
         """Re-derive the config-dependent training state after a parameter
         reset (ResetConfig path used by callback.reset_parameter)."""

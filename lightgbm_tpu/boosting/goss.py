@@ -65,5 +65,20 @@ class GOSS(GBDT):
         # GOSS replaces bagging entirely (handled in _adjust_gradients)
         return
 
+    # ------------------------------------------------------------------
+    def export_train_state(self):
+        """Checkpoint hook: the rest-sampling PRNGKey is chained
+        (split per iteration), so resume must restore the exact key —
+        reseeding from config would replay early draws.  (The fused
+        partitioned GOSS path is stateless: it folds a base key with the
+        iteration number inside the chunk program.)"""
+        arrays, py = super().export_train_state()
+        arrays["goss_key"] = np.asarray(self._goss_key)
+        return arrays, py
+
+    def import_train_state(self, arrays, py) -> None:
+        super().import_train_state(arrays, py)
+        self._goss_key = jnp.asarray(np.asarray(arrays["goss_key"]))
+
     def sub_model_name(self) -> str:
         return "tree"

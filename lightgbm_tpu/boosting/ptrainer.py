@@ -246,6 +246,35 @@ class PartitionedTrainer:
         self._last_tree = None
         return True
 
+    # -- checkpoint support -------------------------------------------
+    def export_perm(self):
+        """The physical row permutation (ROWID channel).  Histogram
+        accumulation order follows the partition layout each tree left
+        behind, so bit-identical resume must restore it — rebuilding an
+        identity layout would change float summation order."""
+        lay = self.layout
+        return np.asarray(self.p[lay.ROWID, : self.num_rows], np.int32)
+
+    def import_perm(self, rowid) -> None:
+        """Re-derive the packed matrix in the checkpointed physical row
+        order: column ``j`` must hold original row ``rowid[j]``.  The
+        matrix here is still identity-packed (fresh ``__init__``), so a
+        single column gather permutes bins/label/weight/rowid together;
+        score channels stay zero and re-sync exactly from the restored
+        original-order scores at the next chunk."""
+        rowid = np.asarray(rowid, np.int32)
+        if rowid.shape != (self.num_rows,):
+            from ..utils.log import Log
+
+            Log.fatal(
+                "checkpoint row permutation has shape %s, expected (%d,)",
+                rowid.shape, self.num_rows,
+            )
+        head = jnp.take(self.p[:, : self.num_rows], jnp.asarray(rowid), axis=1)
+        self.p = jnp.concatenate([head, self.p[:, self.num_rows:]], axis=1)
+        self._last_tree = None
+        self.score_dirty = True
+
     # -- the fused chunk program --------------------------------------
     def _build_program(self, T: int, bag_on: bool, bag_freq: int, used_features: int):
         lay = self.layout
@@ -1059,6 +1088,70 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
         self._apply_delta(neg)
         self._last_tree = None
         return True
+
+    # -- checkpoint support -------------------------------------------
+    def _local_shards_sorted(self):
+        return sorted(self.p.addressable_shards,
+                      key=lambda s: (s.index[0].start or 0))
+
+    def export_perm(self):
+        """(d, nl) int32 — every shard's ROWID channel (shard-LOCAL row
+        ids: split_stream permutes columns within a shard only).
+        COLLECTIVE in multi-process runs: local shards are allgathered
+        over parallel/collect.py so every host returns the full global
+        matrix and host 0 can write it."""
+        import pickle
+
+        import jax as _jax
+
+        lay = self.layout
+        local = np.stack([
+            np.asarray(s.data)[0, lay.ROWID, : self.num_rows]
+            for s in self._local_shards_sorted()
+        ]).astype(np.int32)
+        if _jax.process_count() > 1:
+            from ..parallel.collect import allgather_bytes
+
+            parts = [pickle.loads(b)
+                     for b in allgather_bytes(pickle.dumps(local))]
+            return np.concatenate(parts, axis=0)
+        return local
+
+    def import_perm(self, rowid) -> None:
+        """Permute each addressable shard's columns to the checkpointed
+        layout (host-side: the shards were just packed identity-order in
+        ``__init__``) and rebuild the global array on the same devices."""
+        import jax as _jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rowid = np.asarray(rowid, np.int64)
+        if rowid.shape != (self.d, self.num_rows):
+            from ..utils.log import Log
+
+            Log.fatal(
+                "checkpoint shard permutation has shape %s, expected (%d, %d)",
+                rowid.shape, self.d, self.num_rows,
+            )
+        nl = self.num_rows
+        bufs, devs = [], []
+        for s in self._local_shards_sorted():
+            g = s.index[0].start or 0
+            arr = np.array(s.data)  # (1, C, nl + BLK) host copy
+            arr[0, :, :nl] = arr[0, :, :nl][:, rowid[g]]
+            bufs.append(arr)
+            devs.append(s.device)
+        sharding = NamedSharding(self.mesh, P("data"))
+        if _jax.process_count() > 1:
+            self.p = _jax.make_array_from_single_device_arrays(
+                self.p.shape, sharding,
+                [_jax.device_put(b, d) for b, d in zip(bufs, devs)],
+            )
+        else:
+            self.p = _jax.device_put(
+                jnp.asarray(np.concatenate(bufs, axis=0)), sharding
+            )
+        self._last_tree = None
+        self.score_dirty = True
 
     # ------------------------------------------------------------------
     def _build_program(self, T: int, bag_on: bool, bag_freq: int, used_features: int):

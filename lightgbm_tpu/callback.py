@@ -76,7 +76,23 @@ def record_evaluation(eval_result: dict) -> Callable:
             eval_result[data_name].setdefault(eval_name, [])
             eval_result[data_name][eval_name].append(result)
 
+    # checkpoint hooks (ckpt/manager.py): the recorded history lives in
+    # the caller's dict and must survive a kill/resume
+    def ckpt_state():
+        return {d: {m: list(v) for m, v in dd.items()}
+                for d, dd in eval_result.items()}
+
+    def ckpt_restore(state):
+        eval_result.clear()
+        for d, dd in state.items():
+            eval_result[d] = collections.OrderedDict(
+                (m, [float(x) for x in v]) for m, v in dd.items()
+            )
+
     callback.order = 20
+    callback.ckpt_name = "record_evaluation"
+    callback.ckpt_state = ckpt_state
+    callback.ckpt_restore = ckpt_restore
     return callback
 
 
@@ -163,5 +179,34 @@ def early_stopping(stopping_rounds: int, verbose: bool = True) -> Callable:
                     )
                 raise EarlyStopException(best_iter[i], best_score_list[i])
 
+    # checkpoint hooks (ckpt/manager.py): the closure's bests/counters
+    # are the patience state — without them a resumed run would restart
+    # the stopping_rounds window and stop late
+    def ckpt_state():
+        return {
+            "best_score": list(best_score),
+            "best_iter": list(best_iter),
+            "best_score_list": [
+                None if b is None else [list(x) for x in b]
+                for b in best_score_list
+            ],
+            "bigger": [bool(op(1.0, 0.0)) for op in cmp_op],
+        }
+
+    def ckpt_restore(state):
+        best_score[:] = [float(x) for x in state["best_score"]]
+        best_iter[:] = [int(x) for x in state["best_iter"]]
+        best_score_list[:] = [
+            None if b is None else [tuple(x) for x in b]
+            for b in state["best_score_list"]
+        ]
+        cmp_op[:] = [
+            (lambda x, y: x > y) if big else (lambda x, y: x < y)
+            for big in state["bigger"]
+        ]
+
     callback.order = 30
+    callback.ckpt_name = "early_stopping"
+    callback.ckpt_state = ckpt_state
+    callback.ckpt_restore = ckpt_restore
     return callback

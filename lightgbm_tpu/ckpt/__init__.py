@@ -1,0 +1,41 @@
+"""Fault-tolerant training: checkpoint/resume subsystem.
+
+The reference's only fault-tolerance story is ``snapshot_freq`` — a
+periodic synchronous model-text dump (gbdt.cpp Application::Train) that
+cannot restore TRAINING state: DART's drop RNG, GOSS's PRNG key, the
+bagging/feature-fraction RNG streams, score caches and early-stopping
+bests all restart from scratch, so a resumed run silently diverges.
+
+Here a checkpoint is the complete training state, and resume is
+**bit-identical** to never having died:
+
+  ``state.py``    versioned ``TrainState`` — ensemble trees in binary
+                  (stacked SoA arrays, no model-text reparse), train and
+                  valid score caches, every RNG stream, early-stopping
+                  bests, plus config/dataset fingerprints that refuse
+                  resume on mismatch.
+  ``store.py``    atomic tmp+fsync+rename writes with a CRC manifest,
+                  rolling retention, and latest-valid discovery that
+                  skips a corrupt tail checkpoint.
+  ``manager.py``  ``CheckpointManager`` — a training callback with
+                  off-thread background writes, SIGTERM/preemption
+                  flush-and-exit, and multihost coordination (all hosts
+                  barrier on the checkpointed iteration; host 0 writes).
+
+See docs/CHECKPOINT.md for the state layout, atomicity guarantees,
+multihost protocol and the preemption flow.
+"""
+
+from .manager import CheckpointManager, PreemptionExit  # noqa: F401
+from .state import CheckpointMismatch, TrainState, capture, restore  # noqa: F401
+from .store import CheckpointStore  # noqa: F401
+
+__all__ = [
+    "CheckpointManager",
+    "CheckpointMismatch",
+    "CheckpointStore",
+    "PreemptionExit",
+    "TrainState",
+    "capture",
+    "restore",
+]

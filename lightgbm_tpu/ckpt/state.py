@@ -1,0 +1,279 @@
+"""Versioned training-state snapshots (``TrainState``).
+
+A checkpoint must reproduce training *exactly*, so the state is the
+closure of everything the boosting drivers read across an iteration
+boundary:
+
+  - the ensemble's trees in **binary** — stacked SoA arrays in the same
+    spirit as the serving ``PredictorArtifact`` npz layout (one entry
+    per ``Tree`` field, ``(T, M)``/``(T, L)`` padded), but *complete*:
+    training needs bin-space thresholds, leaf counts/parents and
+    per-tree shrinkage that the inference artifact drops, and a text
+    round-trip through ``%g`` formatting would not be bit-faithful;
+  - the device score caches (train + every valid set) in f32;
+  - every RNG stream: the bagging ``RandomState``, the
+    feature-fraction ``utils.random.Random``, DART's drop ``Random``,
+    GOSS's chained ``PRNGKey`` (the fused partitioned trainers need no
+    RNG state — they fold a static base key with the iteration number);
+  - early-stopping bests / messages and the iteration counter;
+  - the fused partitioned trainer's physical row permutation (histogram
+    accumulation order follows the partition layout, so restarting from
+    an identity layout would change float summation order);
+  - config + dataset fingerprints: resume **refuses** to run on a
+    mismatch instead of silently training a different problem.
+
+Serialization is one ``.npz`` (uncompressed — checkpoint cadence beats
+bytes) with a ``__meta__`` JSON entry, mirroring ``serve/artifact.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import io
+import json
+import zlib
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..model.tree import Tree
+from ..utils.log import Log
+
+FORMAT_VERSION = 1
+
+# Tree SoA fields: (name, dtype, padded-axis) where axis "m" arrays hold
+# num_leaves-1 node records and "l" arrays hold num_leaves leaf records.
+_TREE_FIELDS = (
+    ("left_child", np.int32, "m"),
+    ("right_child", np.int32, "m"),
+    ("split_feature_inner", np.int32, "m"),
+    ("split_feature", np.int32, "m"),
+    ("threshold_in_bin", np.int32, "m"),
+    ("threshold", np.float64, "m"),
+    ("decision_type", np.int8, "m"),
+    ("default_value", np.float64, "m"),
+    ("zero_bin", np.int32, "m"),
+    ("default_bin_for_zero", np.int32, "m"),
+    ("split_gain", np.float64, "m"),
+    ("internal_value", np.float64, "m"),
+    ("internal_count", np.int64, "m"),
+    ("leaf_parent", np.int32, "l"),
+    ("leaf_value", np.float64, "l"),
+    ("leaf_count", np.int64, "l"),
+)
+
+# Config fields that may legitimately differ between the original run
+# and its resume (paths, task plumbing, run length, verbosity) — they
+# never change the per-iteration math, so they stay out of the
+# fingerprint.
+_FP_VOLATILE = {
+    "task", "config_file", "data", "valid_data", "input_model",
+    "output_model", "output_result", "convert_model",
+    "convert_model_language", "num_iterations", "num_iteration_predict",
+    "snapshot_freq", "verbose", "num_threads", "is_save_binary_file",
+    "is_predict_leaf_index", "is_predict_raw_score", "output_freq",
+    "metric_freq", "machine_list_file", "local_listen_port", "time_out",
+    "checkpoint_dir", "checkpoint_freq", "checkpoint_keep",
+    "checkpoint_resume", "is_training_metric", "pred_early_stop",
+    "pred_early_stop_freq", "pred_early_stop_margin",
+}
+
+
+class CheckpointMismatch(RuntimeError):
+    """Resume refused: the checkpoint was written by a different
+    config or against a different dataset."""
+
+
+# ----------------------------------------------------------------------
+# fingerprints
+# ----------------------------------------------------------------------
+def config_fingerprint(config) -> str:
+    """Stable digest of the math-relevant configuration."""
+    d = dataclasses.asdict(config)
+    for key in _FP_VOLATILE:
+        d.pop(key, None)
+    blob = json.dumps(d, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def data_fingerprint(binned_ds) -> str:
+    """Digest of the constructed dataset (binned matrix + label).  CRC32
+    keeps this cheap even at large N; cached on the dataset object so
+    periodic checkpoints don't rescan the matrix."""
+    cached = getattr(binned_ds, "_ckpt_fingerprint", None)
+    if cached is not None:
+        return cached
+    binned = np.ascontiguousarray(np.asarray(binned_ds.binned))
+    crc = zlib.crc32(binned.tobytes())
+    label = binned_ds.metadata.label
+    if label is not None:
+        crc = zlib.crc32(np.ascontiguousarray(np.asarray(label)).tobytes(), crc)
+    fp = f"{binned.shape[0]}x{binned.shape[1]}:{crc:08x}"
+    binned_ds._ckpt_fingerprint = fp
+    return fp
+
+
+# ----------------------------------------------------------------------
+# binary tree pack/unpack (bit-exact round trip)
+# ----------------------------------------------------------------------
+def pack_trees(models) -> Dict[str, np.ndarray]:
+    """List[Tree] -> stacked ``(T, M)``/``(T, L)`` arrays + per-tree
+    scalars, prefixed ``tree_``.  Only the live slices (``num_leaves``)
+    are meaningful; padding is zero."""
+    t = len(models)
+    m = max(max((tr.num_leaves - 1 for tr in models), default=1), 1)
+    li = max(max((tr.num_leaves for tr in models), default=2), 2)
+    out: Dict[str, np.ndarray] = {
+        "tree_num_leaves": np.asarray([tr.num_leaves for tr in models], np.int32),
+        "tree_shrinkage": np.asarray(
+            [tr.shrinkage_rate for tr in models], np.float64
+        ),
+    }
+    for name, dtype, axis in _TREE_FIELDS:
+        width = m if axis == "m" else li
+        arr = np.zeros((t, width), dtype)
+        for i, tr in enumerate(models):
+            n = tr.num_leaves
+            k = max(n - 1, 1) if axis == "m" else n
+            src = getattr(tr, name)
+            arr[i, : min(k, len(src))] = src[: min(k, len(src))]
+        out["tree_" + name] = arr
+    return out
+
+
+def unpack_trees(arrays: Dict[str, np.ndarray]):
+    """Inverse of :func:`pack_trees` — rebuilds host ``Tree`` objects
+    field-for-field (no text round trip)."""
+    num_leaves = np.asarray(arrays["tree_num_leaves"])
+    shrinkage = np.asarray(arrays["tree_shrinkage"])
+    models = []
+    for i in range(len(num_leaves)):
+        n = int(num_leaves[i])
+        tree = Tree(max(n, 2))
+        tree.num_leaves = n
+        for name, dtype, axis in _TREE_FIELDS:
+            k = max(n - 1, 1) if axis == "m" else n
+            dst = getattr(tree, name)
+            src = np.asarray(arrays["tree_" + name][i][:k], dtype)
+            dst[: len(src)] = src
+        tree.shrinkage_rate = float(shrinkage[i])
+        tree.has_categorical = bool(np.any(tree.decision_type[: max(n - 1, 1)] == 1))
+        models.append(tree)
+    return models
+
+
+# ----------------------------------------------------------------------
+# TrainState
+# ----------------------------------------------------------------------
+class TrainState:
+    """One host's complete training state at an iteration boundary."""
+
+    def __init__(self, meta: Dict[str, Any], py: Dict[str, Any],
+                 arrays: Dict[str, np.ndarray]):
+        self.meta = dict(meta)
+        self.py = dict(py)
+        self.arrays = dict(arrays)
+
+    @property
+    def iteration(self) -> int:
+        return int(self.meta["iteration"])
+
+    # -- serialization -------------------------------------------------
+    def to_bytes(self) -> bytes:
+        payload = dict(self.arrays)
+        header = {"meta": self.meta, "py": self.py}
+        payload["__meta__"] = np.asarray(json.dumps(header, default=str))
+        buf = io.BytesIO()
+        np.savez(buf, **payload)
+        return buf.getvalue()
+
+    @classmethod
+    def from_bytes(cls, blob: bytes) -> "TrainState":
+        with np.load(io.BytesIO(blob), allow_pickle=False) as z:
+            if "__meta__" not in z:
+                raise ValueError("not a TrainState blob (no __meta__)")
+            header = json.loads(str(z["__meta__"]))
+            arrays = {k: z[k] for k in z.files if k != "__meta__"}
+        meta = header["meta"]
+        if int(meta.get("format_version", -1)) != FORMAT_VERSION:
+            raise ValueError(
+                f"unsupported TrainState format_version "
+                f"{meta.get('format_version')} (supported: {FORMAT_VERSION})"
+            )
+        return cls(meta, header["py"], arrays)
+
+
+# ----------------------------------------------------------------------
+# capture / restore
+# ----------------------------------------------------------------------
+def capture(booster, extra_py: Optional[Dict[str, Any]] = None) -> TrainState:
+    """Snapshot a live ``Booster`` into a :class:`TrainState`.
+
+    Pure reads — device arrays are pulled to host, nothing is mutated.
+    ``extra_py`` lets the manager attach callback state (early stopping,
+    eval history) captured at the same boundary."""
+    from ..obs import tracer
+
+    b = booster.boosting
+    with tracer.span("ckpt.capture"):
+        arrays, py = b.export_train_state()
+        arrays.update(pack_trees(b.models))
+        meta = {
+            "format_version": FORMAT_VERSION,
+            "iteration": int(b.iter),
+            "boosting_type": type(b).__name__.lower(),
+            "num_models": len(b.models),
+            "num_tree_per_iteration": int(b.num_tree_per_iteration),
+            "num_data": int(b.num_data),
+            "config_fingerprint": config_fingerprint(b.config),
+            "data_fingerprint": data_fingerprint(b.train_set),
+            "num_valid": len(b.valid_scores),
+            "best_iteration": int(getattr(booster, "best_iteration", -1)),
+        }
+        if extra_py:
+            py.update(extra_py)
+    return TrainState(meta, py, arrays)
+
+
+def restore(booster, state: TrainState) -> TrainState:
+    """Load a :class:`TrainState` into a freshly-constructed ``Booster``
+    (same params, same dataset, valid sets already added).  Refuses on a
+    config/dataset fingerprint mismatch."""
+    from ..obs import tracer
+
+    b = booster.boosting
+    cfp, dfp = config_fingerprint(b.config), data_fingerprint(b.train_set)
+    if state.meta["config_fingerprint"] != cfp:
+        raise CheckpointMismatch(
+            "checkpoint was written under a different training config "
+            f"(checkpoint {state.meta['config_fingerprint']}, run {cfp}); "
+            "refusing to resume — clear the checkpoint directory to start over"
+        )
+    if state.meta["data_fingerprint"] != dfp:
+        raise CheckpointMismatch(
+            "checkpoint was written against a different dataset "
+            f"(checkpoint {state.meta['data_fingerprint']}, run {dfp}); "
+            "refusing to resume"
+        )
+    want_bt = type(b).__name__.lower()
+    if state.meta["boosting_type"] != want_bt:
+        raise CheckpointMismatch(
+            f"checkpoint boosting type {state.meta['boosting_type']} != {want_bt}"
+        )
+    if int(state.meta["num_valid"]) != len(b.valid_scores):
+        raise CheckpointMismatch(
+            f"checkpoint has {state.meta['num_valid']} valid sets, "
+            f"run registered {len(b.valid_scores)}"
+        )
+    with tracer.span("ckpt.restore", iter=state.iteration):
+        b.models = unpack_trees(state.arrays)
+        b.import_train_state(state.arrays, state.py)
+        bi = int(state.meta.get("best_iteration", -1))
+        if bi > 0:
+            booster.best_iteration = bi
+    tracer.event("ckpt.restored", iter=state.iteration,
+                 num_models=len(b.models))
+    Log.info("Resumed training state at iteration %d (%d trees)",
+             state.iteration, len(b.models))
+    return state

@@ -76,7 +76,16 @@ def load_all_params(argv: List[str]) -> Dict[str, str]:
 
 
 def run_train(config: Config, params: Dict[str, str]) -> None:
-    """InitTrain + Train (application.cpp:188-250)."""
+    """InitTrain + Train (application.cpp:188-250).
+
+    Fault tolerance (docs/CHECKPOINT.md): ``snapshot_freq`` now writes
+    REAL training-state checkpoints through ``ckpt/`` (the reference's
+    periodic model-text dump is still emitted alongside for reference
+    compat), and ``task=train`` auto-resumes an interrupted run from the
+    latest valid checkpoint in ``output_model``'s directory — the
+    resumed run is bit-identical to one that never died.  SIGTERM
+    (preemption) flushes a checkpoint at the next iteration boundary and
+    exits cleanly."""
     if not config.data:
         Log.fatal("No training data, application quit")
     train_ds = Dataset(config.data, params=dict(params))
@@ -87,21 +96,59 @@ def run_train(config: Config, params: Dict[str, str]) -> None:
     if config.is_save_binary_file:
         train_ds.save_binary(config.data + ".bin")
 
+    from .ckpt import CheckpointManager, PreemptionExit
+
     b = booster.boosting
     num_iters = config.num_iterations
+    ckpt_freq = config.checkpoint_freq or config.snapshot_freq
+    resume = str(config.checkpoint_resume).lower()
+    mgr = None
+    start_iter = 0
+    if ckpt_freq > 0 or resume == "force":
+        ckpt_dir = config.checkpoint_dir or (
+            os.path.dirname(os.path.abspath(config.output_model))
+        )
+        mgr = CheckpointManager(ckpt_dir, freq=max(ckpt_freq, 0),
+                                keep_last=config.checkpoint_keep)
+        mgr.install_signal_handlers()
+        if resume not in ("false", "0", "none", ""):
+            state = mgr.try_restore(
+                booster, require=(resume == "force"),
+                ignore_complete=(resume == "force"),
+            )
+            if state is not None:
+                start_iter = state.iteration
+                Log.info("Resuming training from checkpoint at iteration %d",
+                         start_iter)
+
     Log.info("Started training...")
-    for it in range(num_iters):
-        start = time.time()
-        finished = b.train_one_iter(is_eval=True)
-        Log.info("%f seconds elapsed, finished iteration %d",
-                 time.time() - start, it + 1)
-        if config.snapshot_freq > 0 and (it + 1) % config.snapshot_freq == 0:
-            snap = f"{config.output_model}.snapshot_iter_{it + 1}"
-            b.save_model_to_file(snap)
-            Log.info("Saved snapshot to %s", snap)
-        if finished:
-            Log.info("Early stopping at iteration %d", it + 1)
-            break
+    try:
+        for it in range(start_iter, num_iters):
+            start = time.time()
+            finished = b.train_one_iter(is_eval=True)
+            Log.info("%f seconds elapsed, finished iteration %d",
+                     time.time() - start, it + 1)
+            if config.snapshot_freq > 0 and (it + 1) % config.snapshot_freq == 0:
+                # reference-compat model text alongside the real checkpoint
+                snap = f"{config.output_model}.snapshot_iter_{it + 1}"
+                b.save_model_to_file(snap)
+                Log.info("Saved snapshot to %s", snap)
+            if mgr is not None:
+                mgr.maybe_save(booster)
+            if finished:
+                Log.info("Early stopping at iteration %d", it + 1)
+                break
+    except PreemptionExit as px:
+        mgr.flush()
+        Log.warning(
+            "Training preempted: checkpoint flushed at iteration %d; "
+            "rerun task=train (or `python -m lightgbm_tpu resume`) to "
+            "continue bit-identically", px.step,
+        )
+        return
+    if mgr is not None:
+        mgr.mark_complete(booster)
+        mgr.close()
     b.save_model_to_file(config.output_model)
     Log.info("Finished training, model saved to %s", config.output_model)
 
@@ -191,6 +238,11 @@ def main(argv: List[str] = None) -> int:
     if argv and argv[0] == "ingest":
         # subcommand sugar for task=ingest (matches report/serve style)
         argv = ["task=ingest"] + argv[1:]
+    if argv and argv[0] == "resume":
+        # subcommand sugar: task=train that REQUIRES a checkpoint to
+        # resume from (docs/CHECKPOINT.md); plain task=train already
+        # auto-resumes an interrupted run
+        argv = ["task=train", "checkpoint_resume=force"] + argv[1:]
     try:
         params = load_all_params(argv)
         config = Config.from_params(params)

@@ -153,6 +153,18 @@ class Config:
     pred_early_stop: bool = False
     pred_early_stop_freq: int = 10
     pred_early_stop_margin: float = 10.0
+    # --- fault tolerance (ckpt/; TPU-specific extension).  The CLI
+    # writes full training-state checkpoints at snapshot_freq (real
+    # resume, not just a model dump); checkpoint_freq overrides the
+    # cadence, checkpoint_dir the location (default: output_model's
+    # directory), checkpoint_keep the rolling retention, and
+    # checkpoint_resume is auto/true/false (auto resumes only an
+    # interrupted run; see docs/CHECKPOINT.md).
+    checkpoint_dir: str = ""
+    checkpoint_freq: int = 0
+    checkpoint_keep: int = 3
+    checkpoint_resume: str = "auto"
+
     # --- streaming ingest (data/ingest.py; TPU-specific extension).
     # stream_ingest: 'auto' streams text loads above the size threshold
     # (or always under use_two_round_loading), 'true'/'false' force;

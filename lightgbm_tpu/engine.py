@@ -12,6 +12,7 @@ import numpy as np
 
 from . import callback as callback_mod
 from .basic import Booster, Dataset
+from .ckpt.manager import PreemptionExit
 from .config import canonicalize_params
 from .obs import tracer
 from .utils.log import Log
@@ -34,8 +35,21 @@ def train(
     learning_rates=None,
     keep_training_booster: bool = True,
     callbacks=None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_freq: int = 0,
+    checkpoint_keep: int = 3,
+    checkpoint_resume="auto",
+    checkpoint_manager=None,
 ) -> Booster:
-    """lgb.train (engine.py:17-199)."""
+    """lgb.train (engine.py:17-199).
+
+    Fault tolerance (TPU extension, docs/CHECKPOINT.md): pass
+    ``checkpoint_dir``/``checkpoint_freq`` (or a prebuilt
+    ``CheckpointManager`` via ``checkpoint_manager``) to write full
+    training-state checkpoints every ``checkpoint_freq`` iterations.
+    ``checkpoint_resume`` is ``"auto"`` (resume only an interrupted
+    run), ``False`` (never), or ``"force"`` (require a checkpoint).
+    A resumed run is bit-identical to one that never died."""
     tracer.refresh_from_env()  # LIGHTGBM_TPU_TRACE=trace.jsonl
     params = dict(params or {})
     canon = canonicalize_params(params)
@@ -107,6 +121,54 @@ def train(
     cbs_before = sorted(cbs_before, key=lambda c: getattr(c, "order", 0))
     cbs_after = sorted(cbs_after, key=lambda c: getattr(c, "order", 0))
 
+    # checkpoint/resume wiring (ckpt/, docs/CHECKPOINT.md): params may
+    # carry the config-level knobs; explicit arguments win
+    ckpt_mgr = checkpoint_manager
+    own_mgr = False
+    if ckpt_mgr is None:
+        cdir = checkpoint_dir or str(canon.get("checkpoint_dir", "") or "")
+        if cdir:
+            from .ckpt import CheckpointManager
+
+            cfreq = int(checkpoint_freq or canon.get("checkpoint_freq", 0) or 0)
+            ckpt_mgr = CheckpointManager(
+                cdir, freq=cfreq,
+                keep_last=int(canon.get("checkpoint_keep", checkpoint_keep)),
+            )
+            own_mgr = True
+    start_iter = 0
+    if ckpt_mgr is not None:
+        ckpt_mgr.track_callbacks(list(cbs_before) + list(cbs_after))
+        cbs_after = sorted(cbs_after + [ckpt_mgr],
+                           key=lambda c: getattr(c, "order", 0))
+        resume = checkpoint_resume
+        if isinstance(resume, str):
+            resume = resume.lower()
+        if resume not in (False, None, "false", "0", "none"):
+            state = ckpt_mgr.try_restore(
+                booster, require=(resume == "force"),
+                ignore_complete=(resume == "force"),
+            )
+            if state is not None:
+                start_iter = state.iteration
+
+    def _finalize(b: Booster) -> Booster:
+        if ckpt_mgr is not None:
+            if ckpt_mgr.preempted:
+                ckpt_mgr.flush()  # preempted: leave resumable state
+            else:
+                ckpt_mgr.mark_complete(b)
+            if own_mgr:
+                ckpt_mgr.close()
+        return b
+
+    def _ckpt_bounded(step: int, i: int) -> int:
+        """Clip a fused-chunk length so chunk ends land on checkpoint
+        boundaries (the manager can only capture between dispatches)."""
+        if ckpt_mgr is not None and ckpt_mgr.freq > 0:
+            step = min(step, ckpt_mgr.freq - (i % ckpt_mgr.freq))
+        return max(step, 1)
+
     # Fused fast path: with no per-iteration host decisions (no valid
     # sets, no custom objective, no before-iteration callbacks, no early
     # stopping) the whole run executes as chunked device programs —
@@ -119,16 +181,27 @@ def train(
         and not cbs_before
         and not (early_stopping_rounds and early_stopping_rounds > 0)
     ):
-        iter_before = booster.boosting.iter
-        booster.boosting.train_iters_partitioned(num_boost_round, is_eval=False)
-        done = booster.boosting.iter - iter_before
-        for i in range(done):
-            for cb in cbs_after:
-                cb(callback_mod.CallbackEnv(booster, params, i, 0, num_boost_round, []))
-        if done < num_boost_round:
-            Log.info("Finished training with %d iterations", done)
+        i = start_iter
+        stopped = False
+        while i < num_boost_round and not stopped:
+            step = _ckpt_bounded(num_boost_round - i, i)
+            iter_before = booster.boosting.iter
+            stopped = booster.boosting.train_iters_partitioned(step, is_eval=False)
+            done = booster.boosting.iter - iter_before
+            try:
+                for t in range(done):
+                    for cb in cbs_after:
+                        cb(callback_mod.CallbackEnv(
+                            booster, params, i + t, 0, num_boost_round, []))
+            except PreemptionExit:
+                booster.best_iteration = booster.current_iteration()
+                return _finalize(booster)
+            i += done
+            if done < step:
+                Log.info("Finished training with %d iterations", i)
+                break
         booster.best_iteration = booster.current_iteration()
-        return booster
+        return _finalize(booster)
 
     # Fused path WITH eval: when an eval period > 1 is configured
     # (output_freq, or an integer verbose_eval), run fused chunks of
@@ -148,9 +221,9 @@ def train(
         and not cbs_before
         and period > 1
     ):
-        i = 0
+        i = start_iter
         while i < num_boost_round:
-            step = min(period, num_boost_round - i)
+            step = _ckpt_bounded(min(period, num_boost_round - i), i)
             iter_before = booster.boosting.iter
             booster.boosting.train_iters_partitioned(step, is_eval=False)
             done = booster.boosting.iter - iter_before
@@ -170,15 +243,17 @@ def train(
                 booster.best_iteration = es.best_iteration + 1
                 _record_best_score(booster, es.best_score)
                 break
+            except PreemptionExit:
+                break
             if done < step:
                 Log.info("Finished training with %d iterations", i)
                 break
         if booster.best_iteration <= 0:
             booster.best_iteration = booster.current_iteration()
-        return booster
+        return _finalize(booster)
 
     # training loop
-    for i in range(num_boost_round):
+    for i in range(start_iter, num_boost_round):
         for cb in cbs_before:
             cb(callback_mod.CallbackEnv(booster, params, i, 0, num_boost_round, None))
         finished = booster.update(fobj=fobj)
@@ -196,12 +271,14 @@ def train(
             booster.best_iteration = es.best_iteration + 1
             _record_best_score(booster, es.best_score)
             break
+        except PreemptionExit:
+            break
         if finished:
             Log.info("Finished training with %d iterations", i + 1)
             break
     if booster.best_iteration <= 0:
         booster.best_iteration = booster.current_iteration()
-    return booster
+    return _finalize(booster)
 
 
 def _metric_rank(name: str, params: Dict[str, Any]) -> int:

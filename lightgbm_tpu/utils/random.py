@@ -19,6 +19,17 @@ class Random:
     def __init__(self, seed: int = 123456789):
         self.x = int(seed) & 0xFFFFFFFF
 
+    # -- checkpoint support --------------------------------------------
+    # The whole generator is the 32-bit LCG word, so state export is one
+    # int; model text cannot carry it, which is exactly why checkpoint
+    # resume needs it (ckpt/state.py).
+    def get_state(self) -> int:
+        return int(self.x)
+
+    def set_state(self, state: int) -> "Random":
+        self.x = int(state) & 0xFFFFFFFF
+        return self
+
     def next_short(self, lower_bound: int, upper_bound: int) -> int:
         """Random int in [lower_bound, upper_bound), 15-bit source."""
         return self._rand_int16() % (upper_bound - lower_bound) + lower_bound

@@ -94,6 +94,70 @@ if mode == "findbin":
     print(f"rank {rank} findbin done: {len(ds.bin_mappers)} mappers")
     sys.exit(0)
 
+if mode == "ckptresume":
+    # 2-process sharded-ptrainer checkpoint/resume: train uninterrupted
+    # for 6 iters (reference hash), then a second run that "dies" at
+    # iteration 3 (KeyboardInterrupt from a callback — both ranks throw
+    # at the same boundary, so no collective is left half-entered), then
+    # a third run that auto-resumes from the rank-0-written checkpoint.
+    # The resumed model must be BIT-identical to the uninterrupted one
+    # on both ranks (exercises the multihost barrier, the host-0 write,
+    # the per-rank container unwrap, and the sharded perm export/import).
+    import json
+
+    os.environ["LIGHTGBM_TPU_PGROW"] = "force"
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.boosting.ptrainer import ShardedPartitionedTrainer
+    from lightgbm_tpu.ckpt import CheckpointManager
+
+    rng = np.random.default_rng(5)
+    N, F = 3000, 6
+    X = rng.integers(0, 12, size=(N, F)).astype(np.float32)
+    wv = rng.standard_normal(F)
+    yp = 1.0 / (1.0 + np.exp(-((X - 6) @ wv * 0.3)))
+    y = (rng.random(N) < yp).astype(np.float32)
+    cut = 1700
+    sl = slice(0, cut) if rank == 0 else slice(cut, N)
+    p = dict(objective="binary", tree_learner="data", num_machines=2,
+             pre_partition=True, num_leaves=15, learning_rate=0.2,
+             max_bin=31, min_data_in_leaf=20, verbose=-1)
+
+    def mk():
+        return lgb.Dataset(X[sl], label=y[sl], params=dict(p))
+
+    ref = lgb.train(dict(p), mk(), 6, verbose_eval=False)
+    assert isinstance(ref.boosting.ptrainer, ShardedPartitionedTrainer)
+    ref_str = ref.model_to_string()
+
+    ckdir = out + f".ckpt"  # shared tmp dir: both ranks see the same files
+
+    def killer(env):
+        if env.iteration + 1 == 3:
+            raise KeyboardInterrupt
+    killer.order = 99
+
+    mgr = CheckpointManager(ckdir, freq=2)
+    try:
+        lgb.train(dict(p), mk(), 6, verbose_eval=False,
+                  checkpoint_manager=mgr, callbacks=[killer])
+        raise AssertionError("expected the simulated death")
+    except KeyboardInterrupt:
+        pass
+    mgr.close()
+
+    mgr2 = CheckpointManager(ckdir, freq=2)
+    resumed = lgb.train(dict(p), mk(), 6, verbose_eval=False,
+                        checkpoint_manager=mgr2)
+    mgr2.close()
+    match = resumed.model_to_string() == ref_str
+    if rank == 0:
+        with open(out, "w") as fh:
+            json.dump({"match": bool(match), "trees": resumed.num_trees,
+                       "model": resumed.model_to_string()}, fh)
+    assert match, f"rank {rank}: resumed model diverged from uninterrupted"
+    print(f"rank {rank} ckptresume done: match={match}")
+    sys.exit(0)
+
 if mode == "ptrainer":
     # fused data-parallel trainer (ShardedPartitionedTrainer) across two
     # processes: each rank holds a DIFFERENT row half (pre_partition);

@@ -1,0 +1,192 @@
+"""Kill/resume fault injection (docs/CHECKPOINT.md acceptance matrix).
+
+A real training subprocess (the CLI, exactly what a preemptible node
+runs) is SIGKILLed or SIGTERMed once its first checkpoint lands; the
+rerun auto-resumes from the latest valid checkpoint and the final model
+file must be byte-identical to an uninterrupted run of the same command.
+
+The quick smoke (one SIGKILL + one SIGTERM, gbdt+bagging) runs in
+tier-1; the full multi-kill matrix over {gbdt+bagging, GOSS, DART} with
+randomized kill points is marked ``slow`` (the 2-process sharded
+ptrainer leg lives in test_multihost.py).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = dict(
+    os.environ,
+    JAX_PLATFORMS="cpu",
+    PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+)
+
+BASE_ARGS = [
+    "task=train", "objective=binary", "num_leaves=15", "learning_rate=0.2",
+    "min_data_in_leaf=20", "num_trees=60", "snapshot_freq=5", "verbose=1",
+]
+VARIANTS = {
+    "gbdt_bagging": ["bagging_fraction=0.7", "bagging_freq=2",
+                     "feature_fraction=0.8"],
+    "goss": ["boosting=goss", "learning_rate=0.3", "top_rate=0.3",
+             "other_rate=0.2"],
+    "dart": ["boosting=dart", "drop_rate=0.4", "drop_seed=7"],
+}
+
+
+@pytest.fixture(scope="module")
+def data_file(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("faultdata") / "fault.train")
+    rng = np.random.RandomState(0)
+    X = rng.randn(2500, 10)
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 + 0.1 * rng.randn(2500) > 0).astype(int)
+    np.savetxt(path, np.column_stack([y, X]), fmt="%.10g", delimiter="\t")
+    return path
+
+
+def _cmd(data_file, workdir, extra):
+    model = os.path.join(workdir, "model.txt")
+    return (
+        [sys.executable, "-m", "lightgbm_tpu",
+         f"data={data_file}", f"output_model={model}"]
+        + BASE_ARGS + extra,
+        model,
+    )
+
+
+def _run_to_completion(data_file, workdir, extra):
+    os.makedirs(workdir, exist_ok=True)
+    cmd, model = _cmd(data_file, workdir, extra)
+    r = subprocess.run(cmd, cwd=workdir, env=ENV, capture_output=True,
+                       text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert os.path.exists(model), r.stdout
+    return model, r.stdout
+
+
+def _wait_for_checkpoints(workdir, min_entries, proc, timeout=420):
+    """Poll the CRC manifest until >= min_entries checkpoints are
+    durable (a manifest entry only exists after the fsync'd rename)."""
+    manifest = os.path.join(workdir, "MANIFEST.json")
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            return False  # child finished before we could kill it
+        try:
+            with open(manifest) as f:
+                if len(json.load(f).get("entries", {})) >= min_entries:
+                    return True
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.02)
+    raise TimeoutError("no checkpoint appeared before the kill deadline")
+
+
+def _kill_and_resume(data_file, workdir, extra, sig, min_entries=1):
+    cmd, model = _cmd(data_file, workdir, extra)
+    child = subprocess.Popen(cmd, cwd=workdir, env=ENV,
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+    try:
+        armed = _wait_for_checkpoints(workdir, min_entries, child)
+    except BaseException:
+        child.kill()
+        child.communicate()
+        raise
+    if not armed:
+        out, _ = child.communicate()
+        pytest.fail("training finished before the kill landed:\n" + out[-2000:])
+    child.send_signal(sig)
+    out, _ = child.communicate(timeout=300)
+    if sig == signal.SIGTERM:
+        # graceful preemption: checkpoint flushed, clean exit
+        assert child.returncode == 0, out[-2000:]
+        assert "preempted" in out.lower(), out[-2000:]
+    else:
+        assert child.returncode != 0  # SIGKILL: died hard
+    assert not os.path.exists(model), "killed run must not have finished"
+
+    # resume: the same command auto-resumes from the latest checkpoint
+    r = subprocess.run(cmd, cwd=workdir, env=ENV, capture_output=True,
+                       text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "Resuming training from checkpoint" in r.stdout, r.stdout[-2000:]
+    return model
+
+
+def _model_hash(path):
+    import hashlib
+
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+# ----------------------------------------------------------------------
+# tier-1 smoke: one SIGKILL and one SIGTERM leg
+# ----------------------------------------------------------------------
+@pytest.mark.faultinject
+@pytest.mark.parametrize("sig", [signal.SIGKILL, signal.SIGTERM],
+                         ids=["sigkill", "sigterm"])
+def test_kill_resume_bit_identical_gbdt(data_file, tmp_path, sig):
+    extra = VARIANTS["gbdt_bagging"]
+    ref_model, _ = _run_to_completion(data_file, str(tmp_path / "ref"), extra)
+    wd = str(tmp_path / "killed")
+    os.makedirs(wd, exist_ok=True)
+    model = _kill_and_resume(data_file, wd, extra, sig)
+    assert _model_hash(model) == _model_hash(ref_model)
+
+
+# ----------------------------------------------------------------------
+# the full multi-kill matrix (slow): every driver, both signals,
+# randomized kill points
+# ----------------------------------------------------------------------
+@pytest.mark.slow
+@pytest.mark.faultinject
+@pytest.mark.parametrize("variant", sorted(VARIANTS))
+@pytest.mark.parametrize("sig", [signal.SIGKILL, signal.SIGTERM],
+                         ids=["sigkill", "sigterm"])
+def test_kill_matrix_bit_identical(data_file, tmp_path, variant, sig):
+    extra = VARIANTS[variant]
+    ref_model, _ = _run_to_completion(data_file, str(tmp_path / "ref"), extra)
+    # randomized kill point: wait for 1-3 durable checkpoints (of ~12)
+    rng = np.random.RandomState(
+        abs(hash((variant, int(sig)))) % (2 ** 31)
+    )
+    min_entries = int(rng.randint(1, 4))
+    wd = str(tmp_path / "killed")
+    os.makedirs(wd, exist_ok=True)
+    model = _kill_and_resume(data_file, wd, extra, sig,
+                             min_entries=min_entries)
+    assert _model_hash(model) == _model_hash(ref_model)
+
+
+@pytest.mark.slow
+@pytest.mark.faultinject
+def test_double_kill_resume(data_file, tmp_path):
+    """Two consecutive kills (the second lands on an already-resumed
+    run) still converge to the uninterrupted model."""
+    extra = VARIANTS["gbdt_bagging"]
+    ref_model, _ = _run_to_completion(data_file, str(tmp_path / "ref"), extra)
+    wd = str(tmp_path / "killed")
+    os.makedirs(wd, exist_ok=True)
+    cmd, model = _cmd(data_file, wd, extra)
+    for entries in (1, 3):
+        child = subprocess.Popen(cmd, cwd=wd, env=ENV,
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT, text=True)
+        if not _wait_for_checkpoints(wd, entries, child):
+            child.communicate()
+            pytest.fail("finished before kill")
+        child.send_signal(signal.SIGKILL)
+        child.communicate()
+    r = subprocess.run(cmd, cwd=wd, env=ENV, capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert _model_hash(model) == _model_hash(ref_model)

@@ -198,6 +198,41 @@ def test_two_process_distributed_find_bin_bit_identical(tmp_path):
 
 
 @pytest.mark.slow
+@pytest.mark.faultinject
+def test_two_process_ckpt_resume_bit_identical(tmp_path):
+    """Checkpoint/resume on the 2-process sharded fused trainer
+    (docs/CHECKPOINT.md multihost protocol): both ranks barrier on the
+    checkpointed iteration, rank 0 writes one container blob holding
+    every rank's state (incl. each shard's physical row permutation),
+    and the resumed run is bit-identical to the uninterrupted one on
+    BOTH ranks (the worker asserts rank-locally; rank 0 reports)."""
+    import json
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(here, "multihost_worker.py")
+    out = str(tmp_path / "ckptresume0.json")
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(r), str(port), out, "ckptresume"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        )
+        for r in (0, 1)
+    ]
+    logs = []
+    for p in procs:
+        o, _ = p.communicate(timeout=900)
+        logs.append(o.decode())
+    assert all(p.returncode == 0 for p in procs), "\n".join(logs)
+    with open(out) as fh:
+        got = json.load(fh)
+    assert got["match"] is True
+    assert got["trees"] >= 6
+
+
+@pytest.mark.slow
 def test_two_process_sketch_merge_bit_identical(tmp_path):
     """Streaming-ingest sketch banks merged across two hosts
     (parallel/collect.py allgather, the ingest mirror of distributed
