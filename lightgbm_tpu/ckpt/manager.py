@@ -165,9 +165,24 @@ class CheckpointManager:
         nproc = jax.process_count()
         if nproc > 1:
             from ..parallel.collect import allgather_bytes
+            from ..parallel.net import NetError
 
-            with tracer.span("ckpt.barrier", iter=step):
-                gathered = allgather_bytes(step.to_bytes(8, "little") + blob)
+            try:
+                with tracer.span("ckpt.barrier", iter=step):
+                    gathered = allgather_bytes(step.to_bytes(8, "little") + blob)
+            except NetError as e:
+                # a peer died or the collective timed out mid-barrier:
+                # nothing from THIS boundary is durable, but the last
+                # completed checkpoint is — flush the writer so it is
+                # fully on disk and surface the failure for the
+                # cooperative abort path (engine/cli auto-resume)
+                self.flush()
+                Log.warning(
+                    "Checkpoint barrier at iteration %d failed (%s); the "
+                    "last completed checkpoint remains the resume point",
+                    step, e,
+                )
+                raise
             steps = [int.from_bytes(g[:8], "little") for g in gathered]
             if len(set(steps)) != 1:
                 Log.fatal(

@@ -17,6 +17,15 @@ from .basic import Booster, Dataset
 from .config import PARAM_ALIASES, Config, canonicalize_params
 from .utils.log import Log
 
+# Exit codes (docs/ROBUSTNESS.md).  sysexits-flavored so supervisors can
+# tell a retryable infrastructure death from a config/data error:
+# EX_TEMPFAIL (75) = a peer died; restarting the job auto-resumes from
+# the last checkpoint.  EX_IOERR (74) = a collective or the distributed
+# bootstrap timed out with peers apparently alive (lost collective,
+# dead tunnel) — also retryable, but worth alerting on.
+EXIT_PEER_FAILURE = 75
+EXIT_NET_TIMEOUT = 74
+
 
 def parse_argv(argv: List[str]) -> Dict[str, str]:
     """key=value argv parsing (LoadParameters, application.cpp:48-61)."""
@@ -97,6 +106,7 @@ def run_train(config: Config, params: Dict[str, str]) -> None:
         train_ds.save_binary(config.data + ".bin")
 
     from .ckpt import CheckpointManager, PreemptionExit
+    from .parallel.net import NetError
 
     b = booster.boosting
     num_iters = config.num_iterations
@@ -146,6 +156,13 @@ def run_train(config: Config, params: Dict[str, str]) -> None:
             "continue bit-identically", px.step,
         )
         return
+    except NetError:
+        # peer failure / collective timeout: keep the last completed
+        # checkpoint durable and let main() map the typed error to a
+        # retryable exit code (docs/ROBUSTNESS.md cooperative abort)
+        if mgr is not None:
+            mgr.flush()
+        raise
     if mgr is not None:
         mgr.mark_complete(booster)
         mgr.close()
@@ -243,6 +260,8 @@ def main(argv: List[str] = None) -> int:
         # resume from (docs/CHECKPOINT.md); plain task=train already
         # auto-resumes an interrupted run
         argv = ["task=train", "checkpoint_resume=force"] + argv[1:]
+    from .parallel.net import CollectiveTimeoutError, PeerFailureError
+
     try:
         params = load_all_params(argv)
         config = Config.from_params(params)
@@ -256,10 +275,45 @@ def main(argv: List[str] = None) -> int:
             run_ingest(config, params)
         else:
             Log.fatal("Unknown task type %s", config.task)
+    except PeerFailureError as ex:
+        Log.warning(
+            "Peer failure after %.1fs (ranks %s): %s — restart the job to "
+            "auto-resume from the last checkpoint",
+            ex.elapsed_s, list(ex.ranks), ex,
+        )
+        return _net_exit(EXIT_PEER_FAILURE)
+    except CollectiveTimeoutError as ex:
+        Log.warning(
+            "Collective/bootstrap timeout after %.1fs: %s — restart the "
+            "job to auto-resume from the last checkpoint",
+            ex.elapsed_s, ex,
+        )
+        return _net_exit(EXIT_NET_TIMEOUT)
     except Exception as ex:  # main.cpp catches and exits non-zero
         Log.warning("Met Exceptions: %s", ex)
         return 1
     return 0
+
+
+def _net_exit(code: int) -> int:
+    """Leave after a transport failure.  In a multi-process runtime the
+    survivors must NOT run interpreter atexit hooks: the JAX distributed
+    shutdown barrier blocks ~100 s against the dead peer and then kills
+    the process with a fatal log — so exit through ``net.hard_exit``.
+    Single-process (bootstrap timeouts) returns normally."""
+    try:
+        from jax._src import distributed as _dist
+
+        from .parallel.net import hard_exit
+
+        if _dist.global_state.client is not None:
+            import jax
+
+            if jax.process_count() > 1:
+                hard_exit(code)  # never returns
+    except Exception:  # pragma: no cover - private-API drift tolerated
+        pass
+    return code
 
 
 if __name__ == "__main__":

@@ -165,6 +165,13 @@ class Config:
     checkpoint_keep: int = 3
     checkpoint_resume: str = "auto"
 
+    # --- malformed-input policy (data/reader.py; TPU-specific
+    # extension).  'error' (default) fails loudly naming the file and
+    # data-row number; 'skip' drops malformed/ragged rows, counts them
+    # on the `data.bad_rows` obs counter, and stays bit-identical to
+    # 'error' whenever no rows are bad.
+    bad_row_policy: str = "error"
+
     # --- streaming ingest (data/ingest.py; TPU-specific extension).
     # stream_ingest: 'auto' streams text loads above the size threshold
     # (or always under use_two_round_loading), 'true'/'false' force;
@@ -228,6 +235,17 @@ class Config:
     local_listen_port: int = 12400
     time_out: int = 120
     machine_list_file: str = ""
+    # --- hardened transport (parallel/net.py; TPU-specific extension,
+    # docs/ROBUSTNESS.md).  network_timeout is the per-collective wait
+    # window in SECONDS (the TPU-era replacement of the reference's
+    # socket time_out, which is in minutes); a dead peer surfaces within
+    # ~2x this bound.  network_retries bounds transient-error retries on
+    # an exponential backoff; network_heartbeat_interval=0 auto-derives
+    # (timeout/4, capped at 5 s).  Env vars LIGHTGBM_TPU_NET_TIMEOUT /
+    # _NET_RETRIES / _NET_HEARTBEAT override these params.
+    network_timeout: float = 120.0
+    network_retries: int = 3
+    network_heartbeat_interval: float = 0.0
 
     # --- derived
     is_parallel: bool = False
@@ -293,6 +311,13 @@ class Config:
             Log.fatal("feature_fraction must be in (0, 1], got %s", self.feature_fraction)
         if not (0.0 < self.bagging_fraction <= 1.0):
             Log.fatal("bagging_fraction must be in (0, 1], got %s", self.bagging_fraction)
+        if self.bad_row_policy not in ("error", "skip"):
+            Log.fatal("bad_row_policy must be 'error' or 'skip', got %s",
+                      self.bad_row_policy)
+        if self.network_timeout <= 0:
+            Log.fatal("network_timeout must be > 0, got %s", self.network_timeout)
+        if self.network_retries < 0:
+            Log.fatal("network_retries must be >= 0, got %d", self.network_retries)
         Log.reset_level(self.verbose)
 
 

@@ -199,7 +199,9 @@ def stream_dataset(
         elif int(getattr(config, "stream_chunk_rows", 0) or 0) > 0:
             chunk_rows = int(config.stream_chunk_rows)
     reader = make_reader(path, chunk_rows=chunk_rows,
-                         has_header=config.has_header)
+                         has_header=config.has_header,
+                         bad_row_policy=getattr(config, "bad_row_policy",
+                                                "error"))
     libsvm = isinstance(reader, LibSVMChunkReader)
 
     # -- pass 0: row count (needed up front: the LCG sample draws
@@ -239,7 +241,9 @@ def stream_dataset(
                     tracer.counter("ingest.chunks", phase="pass1")
                     tracer.gauge("ingest.host_rss_mb", rss.tick(), phase="pass1")
                 width = reader.ncols_seen
-                sampled_feats = collector.finish(ncols=width)
+                sampled_feats = collector.finish(
+                    ncols=width, partial=reader.bad_rows > 0
+                )
                 feat_names = [f"Column_{i}" for i in range(width)]
                 roles = ColumnRoles(label_idx=0,
                                     keep=list(range(width)),
@@ -257,7 +261,9 @@ def stream_dataset(
                     chunks_seen += 1
                     tracer.counter("ingest.chunks", phase="pass1")
                     tracer.gauge("ingest.host_rss_mb", rss.tick(), phase="pass1")
-                sampled_feats = collector.finish()[:, keep]
+                sampled_feats = collector.finish(
+                    partial=reader.bad_rows > 0
+                )[:, keep]
             if getattr(config, "is_parallel_find_bin", False):
                 from ..parallel.distributed import ensure_initialized
 
@@ -305,6 +311,7 @@ def stream_dataset(
     keep = np.asarray(roles.keep, dtype=np.int64)
 
     pass2_chunks = 0
+    filled = 0
     with tracer.span("ingest.pass2_bin", rows=int(n)):
         if libsvm:
             target_w = (reference.num_total_features
@@ -319,6 +326,7 @@ def stream_dataset(
                     feats = feats[:, :target_w]
                 bin_rows_into(binned, start, feats, bin_mappers, used_map)
                 label[start : start + len(labels_chunk)] = labels_chunk
+                filled = start + len(labels_chunk)
                 pass2_chunks += 1
                 tracer.counter("ingest.chunks", phase="pass2")
                 tracer.gauge("ingest.host_rss_mb", rss.tick(), phase="pass2")
@@ -331,9 +339,24 @@ def stream_dataset(
                     weights[start:stop] = chunk[:, roles.weight_col].astype(np.float32)
                 if gid is not None:
                     gid[start:stop] = chunk[:, roles.group_col]
+                filled = stop
                 pass2_chunks += 1
                 tracer.counter("ingest.chunks", phase="pass2")
                 tracer.gauge("ingest.host_rss_mb", rss.tick(), phase="pass2")
+
+    if filled < n:
+        # bad_row_policy='skip' dropped rows: pass 0's raw line count
+        # over-allocated; trim to the surviving rows (both passes skip
+        # the SAME rows — the parse is deterministic)
+        Log.warning("%s: %d of %d data rows were malformed and skipped",
+                    path, n - filled, n)
+        report["bad_rows"] = int(n - filled)
+        report["rows"] = int(filled)
+        binned = binned[:filled]
+        label = label[:filled]
+        weights = weights[:filled] if weights is not None else None
+        gid = gid[:filled] if gid is not None else None
+        n = filled
 
     ds.binned = binned
     ds.metadata = Metadata(n)

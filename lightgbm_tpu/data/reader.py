@@ -32,6 +32,49 @@ DEFAULT_CHUNK_BYTES = 32 << 20  # 32 MiB of float64 cells per chunk
 MIN_CHUNK_ROWS = 1024
 MAX_CHUNK_ROWS = 1 << 21
 
+# tokens the salvage parser treats as NaN (pandas C-engine default NA
+# set, lowercased; the fast paths keep their own identical semantics)
+_NA_TOKENS = frozenset({
+    "", "#n/a", "#n/a n/a", "#na", "-1.#ind", "-1.#qnan", "-nan",
+    "1.#ind", "1.#qnan", "<na>", "n/a", "na", "null", "nan", "none",
+})
+
+
+def _parse_value_token(tok: str) -> Optional[float]:
+    """One field -> float (NaN for the NA set), or None if malformed."""
+    t = tok.strip()
+    if t.lower() in _NA_TOKENS:
+        return float("nan")
+    try:
+        return float(t)
+    except ValueError:
+        return None
+
+
+def _report_bad_rows(reader, bad: List[Tuple[int, str]]) -> None:
+    """Apply ``reader.bad_row_policy`` to the triaged rows: 'error'
+    fails loudly naming the file and 1-based data-row number; 'skip'
+    counts them (obs ``data.bad_rows``) and warns once per block."""
+    if not bad:
+        return
+    from ..obs import tracer
+
+    lineno, reason = bad[0]
+    if reader.bad_row_policy != "skip":
+        Log.fatal(
+            "%s: malformed data row %d (%s)%s — set bad_row_policy=skip "
+            "to drop such rows",
+            reader.path, lineno, reason,
+            f" and {len(bad) - 1} more" if len(bad) > 1 else "",
+        )
+    reader.bad_rows += len(bad)
+    tracer.counter("data.bad_rows", len(bad),
+                   file=os.path.basename(reader.path))
+    Log.warning(
+        "%s: skipped %d malformed data row(s); first: row %d (%s)",
+        reader.path, len(bad), lineno, reason,
+    )
+
 
 def auto_chunk_rows(ncols: int, chunk_bytes: int = DEFAULT_CHUNK_BYTES) -> int:
     rows = chunk_bytes // max(8 * max(ncols, 1), 1)
@@ -144,7 +187,8 @@ class DenseChunkReader:
     (idx, value) pairs."""
 
     def __init__(self, path: str, sep: str, has_header: bool,
-                 chunk_rows: Optional[int] = None):
+                 chunk_rows: Optional[int] = None,
+                 bad_row_policy: str = "error"):
         self.path = path
         self.sep = sep
         self.has_header = has_header
@@ -154,6 +198,8 @@ class DenseChunkReader:
         self._chunk_rows = chunk_rows
         self._num_rows: Optional[int] = None
         self._ncols: Optional[int] = None
+        self.bad_row_policy = bad_row_policy
+        self.bad_rows = 0  # cumulative skipped rows (policy='skip')
 
     # -- pass 0 --------------------------------------------------------
     def count_rows(self) -> int:
@@ -179,26 +225,91 @@ class DenseChunkReader:
         return auto_chunk_rows(self.ncols)
 
     # -- chunk iteration ----------------------------------------------
-    def parse_block(self, block: bytes) -> np.ndarray:
-        mat = _native_parse_block(block, self.sep)
+    def parse_block(self, block: bytes, start_row: int = 0) -> np.ndarray:
+        """Parse one block.  The fast paths (native parser, pandas C
+        engine) are tried first and are byte-for-byte what a clean file
+        always gets; only when a block fails to parse — or parses at a
+        width inconsistent with the rest of the file — does the per-line
+        salvage pass run, applying ``bad_row_policy``: 'error' fails
+        loudly naming the file and 1-based data-row number, 'skip' drops
+        the malformed rows and counts them (obs ``data.bad_rows``)."""
+        mat: Optional[np.ndarray] = None
+        try:
+            mat = _native_parse_block(block, self.sep)
+            if mat is None:
+                mat = _pandas_parse_block(block, self.sep)
+        except Exception:
+            mat = None
+        if mat is not None and self._ncols is not None \
+                and mat.shape[1] != self._ncols:
+            mat = None  # width flip mid-file: let salvage name the rows
         if mat is None:
-            mat = _pandas_parse_block(block, self.sep)
-        if self._ncols is None:
+            mat = self._salvage_block(block, start_row)
+        if self._ncols is None and mat.shape[1] > 0:
             self._ncols = mat.shape[1]
-        elif mat.shape[1] != self._ncols:
-            Log.fatal(
-                "Inconsistent column count in %s: chunk has %d, expected %d",
-                self.path, mat.shape[1], self._ncols,
-            )
         return mat
+
+    def _salvage_block(self, block: bytes, start_row: int) -> np.ndarray:
+        """Per-line triage of a block the fast path rejected.  The
+        surviving lines are re-joined and parsed through the SAME fast
+        path (native parser / pandas C engine), so their values are
+        bit-identical to a file that never had the bad rows; the
+        token-level parse is used for validation only (and as a last
+        resort if the fast path rejects even the surviving lines)."""
+        sep = None if self.sep in (None, r"\s+") else self.sep
+        expected = self._ncols
+        rows: List[List[float]] = []
+        good_lines: List[bytes] = []
+        bad: List[Tuple[int, str]] = []  # (1-based data-row number, reason)
+        for raw in block.split(b"\n"):
+            if not raw.strip():
+                continue
+            lineno = start_row + len(rows) + len(bad) + 1
+            toks = raw.decode("utf-8", "replace").strip().split(sep)
+            vals = [_parse_value_token(t) for t in toks]
+            if any(v is None for v in vals):
+                j = next(k for k, v in enumerate(vals) if v is None)
+                bad.append((lineno, f"unparsable value {toks[j]!r} "
+                                    f"in field {j + 1}"))
+                continue
+            if expected is None:
+                expected = len(vals)
+            if len(vals) != expected:
+                bad.append((lineno, f"{len(vals)} fields, expected {expected}"))
+                continue
+            rows.append(vals)  # type: ignore[arg-type]
+            good_lines.append(raw if raw.endswith(b"\n") else raw + b"\n")
+        _report_bad_rows(self, bad)
+        if not rows:
+            return np.empty((0, expected or 0), dtype=np.float64)
+        good_block = b"".join(good_lines)
+        try:
+            mat = _native_parse_block(good_block, self.sep)
+            if mat is None:
+                mat = _pandas_parse_block(good_block, self.sep)
+            if mat.shape == (len(rows), expected):
+                return mat
+        except Exception:
+            pass
+        # the fast path rejects even the validated lines (e.g. quoting
+        # the naive splitter misread): fall back to the token values
+        return np.asarray(rows, dtype=np.float64)
 
     def iter_chunks(self, probe_rows: Optional[int] = None
                     ) -> Iterator[Tuple[int, np.ndarray]]:
-        """Yield ``(start_row, (rows, ncols) float64 matrix)``."""
+        """Yield ``(start_row, (rows, ncols) float64 matrix)``.
+        ``start_row`` counts EMITTED rows, so with ``bad_row_policy=
+        'skip'`` downstream offsets stay dense; on a clean file it is
+        identical to the raw non-blank line index."""
         rows = probe_rows or self.chunk_rows()
         skip = 1 if self.has_header else 0
+        emitted = 0
         for start, block, _ in iter_line_blocks(self.path, rows, skip):
-            yield start, self.parse_block(block)
+            mat = self.parse_block(block, start_row=start)
+            if mat.shape[0] == 0:
+                continue
+            yield emitted, mat
+            emitted += mat.shape[0]
 
     def read_all(self) -> Tuple[np.ndarray, Optional[List[str]]]:
         """Single-shot load (legacy io/parser path): one chunk spanning
@@ -217,13 +328,16 @@ class LibSVMChunkReader:
     global feature count is the max seen index + 1, discovered during
     pass 1 (``grow_ncols``) and then frozen for pass 2 via ``set_ncols``."""
 
-    def __init__(self, path: str, chunk_rows: Optional[int] = None):
+    def __init__(self, path: str, chunk_rows: Optional[int] = None,
+                 bad_row_policy: str = "error"):
         self.path = path
         self.has_header = False
         self.header_names = None
         self._chunk_rows = chunk_rows
         self._num_rows: Optional[int] = None
         self.ncols_seen = 0  # grows as chunks are parsed
+        self.bad_row_policy = bad_row_policy
+        self.bad_rows = 0
 
     def count_rows(self) -> int:
         if self._num_rows is None:
@@ -235,13 +349,47 @@ class LibSVMChunkReader:
             return int(self._chunk_rows)
         return auto_chunk_rows(32)
 
-    def parse_block(self, block: bytes) -> Tuple[np.ndarray, np.ndarray]:
+    def parse_block(self, block: bytes,
+                    start_row: int = 0) -> Tuple[np.ndarray, np.ndarray]:
         mat_lab = self._native_parse(block)
         if mat_lab is None:
-            mat_lab = self._python_parse(block)
+            good_block = self._scan_lines(block, start_row)
+            if good_block is not block:
+                # surviving lines go back through the SAME fast path so
+                # their values match a file without the bad rows
+                mat_lab = self._native_parse(good_block)
+            if mat_lab is None:
+                mat_lab = self._python_parse(good_block)
         feats, labels = mat_lab
         self.ncols_seen = max(self.ncols_seen, feats.shape[1])
         return feats, labels
+
+    def _scan_lines(self, block: bytes, start_row: int) -> bytes:
+        """Validate each line; apply ``bad_row_policy`` to the broken
+        ones.  Returns the block itself when every line is fine, else
+        the surviving lines re-joined."""
+        good: List[bytes] = []
+        bad: List[Tuple[int, str]] = []
+        n_seen = 0
+        for raw in block.split(b"\n"):
+            toks = raw.split()
+            if not toks:
+                continue
+            n_seen += 1
+            lineno = start_row + n_seen
+            try:
+                float(toks[0])
+                for t in toks[1:]:
+                    i, v = t.split(b":")
+                    int(i), float(v)
+            except ValueError as e:
+                bad.append((lineno, str(e)))
+                continue
+            good.append(raw if raw.endswith(b"\n") else raw + b"\n")
+        if not bad:
+            return block
+        _report_bad_rows(self, bad)
+        return b"".join(good)
 
     def _native_parse(self, block: bytes):
         from ..native import get_lib
@@ -297,10 +445,15 @@ class LibSVMChunkReader:
     def iter_chunks(self) -> Iterator[Tuple[int, np.ndarray, np.ndarray]]:
         """Yield ``(start_row, features, labels)``.  Feature matrices are
         chunk-local width; callers pad to a global width (``ncols_seen``
-        after a full pass, or a frozen pass-1 count)."""
+        after a full pass, or a frozen pass-1 count).  ``start_row``
+        counts emitted rows (dense under ``bad_row_policy='skip'``)."""
+        emitted = 0
         for start, block, _ in iter_line_blocks(self.path, self.chunk_rows()):
-            feats, labels = self.parse_block(block)
-            yield start, feats, labels
+            feats, labels = self.parse_block(block, start_row=start)
+            if feats.shape[0] == 0:
+                continue
+            yield emitted, feats, labels
+            emitted += feats.shape[0]
 
     def read_all(self) -> Tuple[np.ndarray, np.ndarray]:
         feats_list, labels_list = [], []
@@ -318,12 +471,14 @@ class LibSVMChunkReader:
 
 
 def make_reader(path: str, chunk_rows: Optional[int] = None,
-                has_header: bool = False):
+                has_header: bool = False, bad_row_policy: str = "error"):
     """Sniff the format (io/parser.sniff_format) and build the matching
     chunked reader."""
     from ..io.parser import sniff_format
 
     kind, sep = sniff_format(path)
     if kind == "libsvm":
-        return LibSVMChunkReader(path, chunk_rows=chunk_rows)
-    return DenseChunkReader(path, sep, has_header, chunk_rows=chunk_rows)
+        return LibSVMChunkReader(path, chunk_rows=chunk_rows,
+                                 bad_row_policy=bad_row_policy)
+    return DenseChunkReader(path, sep, has_header, chunk_rows=chunk_rows,
+                            bad_row_policy=bad_row_policy)

@@ -59,13 +59,17 @@ class SampleCollector:
             c += 1
         self._cursor = c
 
-    def finish(self, ncols: Optional[int] = None) -> np.ndarray:
-        if self._cursor != len(self.indices):
+    def finish(self, ncols: Optional[int] = None,
+               partial: bool = False) -> np.ndarray:
+        """``partial=True`` accepts an incomplete collection and returns
+        only the collected prefix — the bad-row-skip path, where rows
+        sampled past the surviving row count never stream by."""
+        if self._cursor != len(self.indices) and not partial:
             raise RuntimeError(
                 f"sample collection incomplete: {self._cursor}/{len(self.indices)}"
             )
         if self.rows is not None:
-            return self.rows
+            return self.rows[: self._cursor] if partial else self.rows
         width = ncols if ncols is not None else max(
             (len(r) for r in self._row_list), default=0
         )

@@ -15,6 +15,7 @@ from .basic import Booster, Dataset
 from .ckpt.manager import PreemptionExit
 from .config import canonicalize_params
 from .obs import tracer
+from .parallel.net import NetError
 from .utils.log import Log
 
 
@@ -152,6 +153,23 @@ def train(
             if state is not None:
                 start_iter = state.iteration
 
+    def _net_abort(e: NetError) -> None:
+        """Cooperative abort (docs/ROBUSTNESS.md): a peer died or a
+        collective timed out.  Flush the last completed checkpoint so it
+        is durable, then let the typed error propagate — the CLI maps it
+        to a retryable exit code and the next ``task=train`` auto-resumes
+        bit-identically from that boundary."""
+        if ckpt_mgr is not None:
+            try:
+                ckpt_mgr.flush()
+            except Exception:  # pragma: no cover - disk-full etc.
+                pass
+        Log.warning(
+            "Training aborted by transport failure (%s): %s — latest "
+            "completed checkpoint preserved; rerun to auto-resume",
+            type(e).__name__, e,
+        )
+
     def _finalize(b: Booster) -> Booster:
         if ckpt_mgr is not None:
             if ckpt_mgr.preempted:
@@ -196,6 +214,9 @@ def train(
             except PreemptionExit:
                 booster.best_iteration = booster.current_iteration()
                 return _finalize(booster)
+            except NetError as ne:
+                _net_abort(ne)
+                raise
             i += done
             if done < step:
                 Log.info("Finished training with %d iterations", i)
@@ -245,6 +266,9 @@ def train(
                 break
             except PreemptionExit:
                 break
+            except NetError as ne:
+                _net_abort(ne)
+                raise
             if done < step:
                 Log.info("Finished training with %d iterations", i)
                 break
@@ -273,6 +297,9 @@ def train(
             break
         except PreemptionExit:
             break
+        except NetError as ne:
+            _net_abort(ne)
+            raise
         if finished:
             Log.info("Finished training with %d iterations", i + 1)
             break

@@ -70,15 +70,17 @@ def load_text_file(
     # missing-value semantics.  Imported lazily — data/ sits above io/.
     from ..data.reader import DenseChunkReader, LibSVMChunkReader
 
+    policy = getattr(config, "bad_row_policy", "error")
     kind, sep = sniff_format(path)
     if kind == "libsvm":
-        raw, label = LibSVMChunkReader(path).read_all()
+        raw, label = LibSVMChunkReader(path, bad_row_policy=policy).read_all()
         names = [f"Column_{i}" for i in range(raw.shape[1])]
         label_idx = 0
         weights, group = _side_files(path, raw.shape[0])
         return raw, label, weights, group, names, label_idx
 
-    mat, names = DenseChunkReader(path, sep, config.has_header).read_all()
+    mat, names = DenseChunkReader(path, sep, config.has_header,
+                                  bad_row_policy=policy).read_all()
 
     label_idx, _ = _resolve_column(config.label_column, names, default=0)
     weight_idx, weight_abs = _resolve_column(config.weight_column, names, default=-1)

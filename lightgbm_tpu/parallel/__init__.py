@@ -6,5 +6,12 @@ implement it in hardware).
 """
 
 from .learner import ShardedLearner, make_mesh
+from .net import CollectiveTimeoutError, NetError, PeerFailureError
 
-__all__ = ["ShardedLearner", "make_mesh"]
+__all__ = [
+    "ShardedLearner",
+    "make_mesh",
+    "NetError",
+    "PeerFailureError",
+    "CollectiveTimeoutError",
+]

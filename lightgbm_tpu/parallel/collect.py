@@ -3,7 +3,8 @@
 The reference's distributed find-bin allgathers serialized BinMappers
 with fixed-width copy buffers sized by an Allreduce'd max
 (dataset_loader.cpp:733-835).  Here every host-side merge (bin mappers,
-ingest statistics sketches) rides one code path with two transports:
+ingest statistics sketches, checkpoint barriers) rides one code path
+with two transports:
 
 - device arrays via ``multihost_utils.process_allgather`` (length-
   prefixed blobs padded to a gathered max) when the backend supports
@@ -12,6 +13,13 @@ ingest statistics sketches) rides one code path with two transports:
   ``jax.distributed.initialize`` bootstraps from) on backends that do
   not — XLA:CPU rejects multi-process programs outright, which is
   exactly the multi-host ingest test environment.
+
+Both transports are **hardened** through ``parallel/net.py``
+(docs/ROBUSTNESS.md): deadline-bounded waits, heartbeat-based peer
+liveness so a SIGKILLed rank surfaces as ``PeerFailureError`` within
+~2x the deadline instead of hanging every host, ``LIGHTGBM_TPU_FAULT``
+injection points, and KV key GC so a long multihost run's live KV
+footprint stays O(ranks) instead of growing per gather.
 
 The transport is chosen deterministically from the backend name so
 every process takes the same branch (a mixed choice would deadlock).
@@ -26,40 +34,42 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..obs import tracer
+from . import net
+
 # per-process call counter: processes make collective calls in the same
-# program order, so the counter yields matching keys across ranks
+# program order, so the counter yields matching keys across ranks (and
+# net.kv_gather's lazy GC relies on exactly that ordering)
 _kv_uid = itertools.count()
 
 
 def _kv_allgather(blob: bytes) -> List[bytes]:
     import jax
-    from jax._src import distributed
 
-    client = distributed.global_state.client
-    if client is None:
-        raise RuntimeError("distributed runtime not initialized")
-    rank = jax.process_index()
-    nproc = jax.process_count()
-    uid = next(_kv_uid)
-    client.key_value_set(f"ltpu_collect/{uid}/{rank}", blob.hex())
-    out = []
-    for r in range(nproc):
-        v = client.blocking_key_value_get(f"ltpu_collect/{uid}/{r}", 120_000)
-        out.append(bytes.fromhex(v))
-    return out
+    return net.kv_gather(
+        next(_kv_uid), blob,
+        client=net.require_client(),
+        rank=jax.process_index(), nproc=jax.process_count(),
+    )
 
 
 def _array_allgather(blob: bytes) -> List[bytes]:
     import jax
     from jax.experimental import multihost_utils
 
-    gmax = int(np.max(multihost_utils.process_allgather(
-        np.asarray(len(blob), np.int64)
+    gmax = int(np.max(net.watchdog_call(
+        lambda: multihost_utils.process_allgather(
+            np.asarray(len(blob), np.int64)
+        ),
+        what="allgather[sizes]",
     )))
     buf = np.zeros(gmax + 8, np.uint8)
     buf[:8] = np.frombuffer(len(blob).to_bytes(8, "little"), np.uint8)
     buf[8 : 8 + len(blob)] = np.frombuffer(blob, np.uint8)
-    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    gathered = np.asarray(net.watchdog_call(
+        lambda: multihost_utils.process_allgather(buf),
+        what="allgather[payload]",
+    ))
     out = []
     for r in range(gathered.shape[0]):
         ln = int.from_bytes(gathered[r, :8].tobytes(), "little")
@@ -68,15 +78,33 @@ def _array_allgather(blob: bytes) -> List[bytes]:
 
 
 def allgather_bytes(blob: bytes) -> List[bytes]:
-    """One blob per process -> every process's blob, in process order."""
+    """One blob per process -> every process's blob, in process order.
+    Bounded: raises ``net.PeerFailureError`` / ``CollectiveTimeoutError``
+    instead of hanging on a dead or wedged peer."""
     import jax
 
     if jax.process_count() == 1:
         return [blob]
-    if jax.default_backend() == "cpu":
-        # XLA:CPU has no multi-process computations; use the KV store
-        return _kv_allgather(blob)
-    return _array_allgather(blob)
+    net.fault_point("collective")
+    net.ensure_heartbeat()
+    transport = "kv" if jax.default_backend() == "cpu" else "array"
+    with tracer.span("net.allgather", transport=transport, bytes=len(blob)):
+        if transport == "kv":
+            # XLA:CPU has no multi-process computations; use the KV store
+            return _kv_allgather(blob)
+        return _array_allgather(blob)
+
+
+def barrier(tag: str = "barrier") -> None:
+    """All processes reach this point, bounded by the net deadline —
+    an empty allgather, so it rides the same hardened transports and
+    fault-injection points as every other collective."""
+    import jax
+
+    if jax.process_count() == 1:
+        return
+    with tracer.span("net.barrier", tag=tag):
+        allgather_bytes(b"")
 
 
 def allgather_blob_lists(

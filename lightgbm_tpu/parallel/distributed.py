@@ -34,8 +34,60 @@ import jax
 import numpy as np
 
 from ..utils.log import Log
+from . import net
 
 _initialized = False
+
+
+def _bounded_initialize(coord: str, nproc: int, pid: int) -> None:
+    """``jax.distributed.initialize`` under a watchdog with bounded
+    retry — the BENCH_r05 "dead tunnel" fix.  The RPC layer's own
+    ``initialization_timeout`` bounds a *reachable-but-refusing*
+    coordinator; the watchdog additionally bounds a blackholed
+    connection that never errors.  Returned errors retry on the net
+    backoff schedule; a watchdog trip raises immediately (a second
+    concurrent initialize on the same runtime is not safe)."""
+    s = net.settings()
+    deadline = s.deadline_s
+
+    def _attempt():
+        jax.distributed.initialize(
+            coordinator_address=coord, num_processes=nproc, process_id=pid,
+            initialization_timeout=max(int(round(deadline)), 1),
+        )
+
+    import time as _time
+
+    delays = net.backoff_schedule(s.retries, s.backoff_base_s, s.backoff_max_s)
+    t0 = _time.monotonic()
+    for attempt in range(s.retries + 1):
+        try:
+            # the watchdog only trips when initialize neither returns
+            # nor errors (a blackholed tunnel); its trip is NOT retried
+            # — a second concurrent initialize on the same runtime is
+            # not safe while the first may still be in flight
+            net.watchdog_call(_attempt, what="distributed.initialize",
+                              deadline_s=deadline)
+            return
+        except net.NetError:
+            raise
+        except RuntimeError as e:
+            msg = str(e)
+            if "already" in msg or "only be called once" in msg:
+                raise  # caller's already-initialized handling
+            if attempt >= s.retries:
+                elapsed = _time.monotonic() - t0
+                raise net.CollectiveTimeoutError(
+                    f"distributed bootstrap to {coord} failed after "
+                    f"{attempt + 1} attempt(s) in {elapsed:.1f}s: {e}",
+                    elapsed_s=elapsed,
+                ) from e
+            Log.warning(
+                "distributed.initialize failed (attempt %d/%d): %s — "
+                "retrying in %.2fs", attempt + 1, s.retries + 1, e,
+                delays[attempt],
+            )
+            _time.sleep(delays[attempt])
 
 
 def _machines_from_config(config) -> list:
@@ -53,6 +105,8 @@ def ensure_initialized(config=None, process_id: Optional[int] = None) -> bool:
     is multi-process.  Returns True when a multi-process runtime is (or
     already was) active."""
     global _initialized
+    if config is not None:
+        net.configure_from_config(config)
     if _initialized:
         return jax.process_count() > 1
     # NOTE: no jax.devices()/process_count() before initialize — any
@@ -64,7 +118,10 @@ def ensure_initialized(config=None, process_id: Optional[int] = None) -> bool:
 
         if _dist.global_state.client is not None:
             _initialized = True
-            return jax.process_count() > 1
+            if jax.process_count() > 1:
+                net.ensure_heartbeat()
+                return True
+            return False
     except Exception:  # pragma: no cover — private-API drift tolerated
         pass
 
@@ -110,22 +167,36 @@ def ensure_initialized(config=None, process_id: Optional[int] = None) -> bool:
         return False
 
     Log.info(
-        "Initializing distributed runtime: coordinator=%s rank=%d/%d",
-        coord, pid, nproc,
+        "Initializing distributed runtime: coordinator=%s rank=%d/%d "
+        "(deadline=%.0fs, retries=%d)",
+        coord, pid, nproc, net.settings().deadline_s, net.settings().retries,
     )
     try:
-        jax.distributed.initialize(
-            coordinator_address=coord, num_processes=nproc, process_id=pid
-        )
+        _bounded_initialize(coord, pid=pid, nproc=nproc)
+    except net.NetError:
+        # an explicitly-requested multi-process bootstrap that cannot be
+        # established fails LOUDLY and bounded (linkers_socket.cpp does
+        # the same after its connect retries) — silently continuing
+        # single-process is the BENCH_r05 zeroed-benchmark bug class
+        raise
     except RuntimeError as e:  # backend already up (too late) or re-init
         msg = str(e)
         if "already" in msg or "only be called once" in msg:
             _initialized = True
-            return jax.process_count() > 1
+            if jax.process_count() > 1:
+                net.ensure_heartbeat()
+                return True
+            return False
         Log.warning("Distributed init failed: %s", e)
         return False
     _initialized = True
-    return True
+    # backend-init probe: the first backend query after initialize can
+    # itself hang on a dead tunnel — bound it like any other collective
+    nproc_seen = net.watchdog_call(jax.process_count,
+                                   what="backend_init_probe")
+    if nproc_seen > 1:
+        net.ensure_heartbeat()
+    return nproc_seen > 1
 
 
 def is_multiprocess() -> bool:

@@ -6,10 +6,19 @@ Endpoints:
                      prediction per line, same order (a float, or a list
                      for multiclass).  ``?raw_score=1`` skips the
                      objective's output conversion.
-  GET  /healthz      liveness: ``{"status": "ok"}``
+  GET  /healthz      liveness only: ``{"status": "ok"}`` whenever the
+                     process answers.
+  GET  /readyz       readiness: 200 once the artifact is loaded AND the
+                     bucket-ladder warmup completed; 503 while warming
+                     and again while draining — the signal a load
+                     balancer keys traffic on.
   GET  /stats        serving metrics: batcher counters + latency
                      quantiles, bucket-cache compile accounting, queue
-                     depth, uptime.
+                     depth, readiness/drain state, uptime.
+
+Shutdown: SIGTERM starts a graceful drain — ``/readyz`` flips to 503,
+new ``/predict`` requests get 503, in-flight microbatches finish
+(bounded by ``drain_timeout_ms``), then the server exits 0.
 
 Each HTTP request becomes one ``MicroBatcher.submit`` call, so
 concurrent requests coalesce into shared device batches; an overloaded
@@ -26,6 +35,7 @@ request never pays an XLA compile.
 from __future__ import annotations
 
 import json
+import signal
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -47,6 +57,7 @@ DEFAULTS = {
     "warmup": 1,
     "warmup_max_rows": 4096,
     "shard": 0,
+    "drain_timeout_ms": 10000,
 }
 
 
@@ -112,13 +123,55 @@ class PredictServer(ThreadingHTTPServer):
             **opts,
         )
         self.t_start = time.time()
+        # readiness/drain state (docs/ROBUSTNESS.md): ready flips on
+        # once the artifact is loaded and warmup completed; draining
+        # flips /readyz and /predict to 503 while in-flight batches run
+        self.ready = False
+        self.draining = False
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
         super().__init__(addr, _Handler)
+
+    # -- in-flight request accounting ----------------------------------
+    def track_begin(self) -> None:
+        with self._inflight_cv:
+            self._inflight += 1
+
+    def track_end(self) -> None:
+        with self._inflight_cv:
+            self._inflight -= 1
+            if self._inflight <= 0:
+                self._inflight_cv.notify_all()
+
+    def drain(self, timeout_s: float = 10.0) -> bool:
+        """Graceful shutdown: stop admitting work (``/readyz`` and
+        ``/predict`` answer 503), wait for in-flight microbatches to
+        finish (bounded by ``timeout_s``), then stop the accept loop and
+        close the batchers.  Returns True when the drain completed with
+        nothing in flight."""
+        self.draining = True
+        deadline = time.monotonic() + float(timeout_s)
+        with self._inflight_cv:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._inflight_cv.wait(min(remaining, 0.1))
+            drained = self._inflight == 0
+        if not drained:
+            Log.warning("serve: drain timed out with %d request(s) in "
+                        "flight", self._inflight)
+        self.shutdown()
+        return drained
 
     def stats(self) -> Dict:
         cw = compilewatch.snapshot()
         watched = cw["watched"].get("serve.predict_raw", {})
         return {
             "uptime_s": round(time.time() - self.t_start, 1),
+            "ready": self.ready,
+            "draining": self.draining,
+            "inflight": self._inflight,
             "num_features": self.predictor.num_features,
             "num_class": self.predictor.artifact.num_class,
             "batcher": self.batcher.stats(),
@@ -158,6 +211,13 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         if self.path == "/healthz":
             self._reply_json(200, {"status": "ok"})
+        elif self.path == "/readyz":
+            if self.server.draining:
+                self._reply_json(503, {"status": "draining"})
+            elif not self.server.ready:
+                self._reply_json(503, {"status": "warming"})
+            else:
+                self._reply_json(200, {"status": "ready"})
         elif self.path == "/stats":
             self._reply_json(200, self.server.stats())
         else:
@@ -168,6 +228,18 @@ class _Handler(BaseHTTPRequestHandler):
         if path != "/predict":
             self._reply_json(404, {"error": f"unknown path {path}"})
             return
+        if self.server.draining:
+            # shed-not-queue during drain: the LB already saw /readyz
+            # flip; anything still arriving is told to go elsewhere
+            self._reply_json(503, {"error": "server is draining"})
+            return
+        self.server.track_begin()
+        try:
+            self._do_predict(query)
+        finally:
+            self.server.track_end()
+
+    def _do_predict(self, query: str) -> None:
         raw_score = "raw_score=1" in query
         try:
             length = int(self.headers.get("Content-Length") or 0)
@@ -200,11 +272,13 @@ def make_server(model_path: str, host: str = "127.0.0.1", port: int = 0,
     """Build (and optionally warm) a ready-to-run server; ``port=0``
     binds an ephemeral port (tests)."""
     predictor = load_predictor(model_path, shard=shard)
+    server = PredictServer((host, port), predictor, batcher_opts)
     if do_warmup:
         stats = predictor.warmup(warmup_max_rows)
         Log.info("serve: warmup compiled %d programs over buckets %s in %.2fs",
                  stats["compiles"], stats["buckets"], stats["secs"])
-    return PredictServer((host, port), predictor, batcher_opts)
+    server.ready = True  # artifact loaded + warmup complete -> /readyz 200
+    return server
 
 
 def main(argv: List[str]) -> int:
@@ -234,13 +308,30 @@ def main(argv: List[str]) -> int:
         request_timeout_ms=float(opts["request_timeout_ms"]),
     )
     host, port = server.server_address[:2]
-    Log.info("serve: listening on http://%s:%d (POST /predict, GET /stats)",
-             host, port)
+    Log.info("serve: listening on http://%s:%d (POST /predict, GET "
+             "/healthz /readyz /stats)", host, port)
+
+    drain_timeout_s = float(opts["drain_timeout_ms"]) / 1e3
+
+    def _on_sigterm(signum, frame):
+        # graceful drain off the signal context: flip /readyz, let
+        # in-flight microbatches finish, then stop serve_forever
+        Log.warning("serve: SIGTERM — draining (timeout %.1fs)",
+                    drain_timeout_s)
+        threading.Thread(target=server.drain, args=(drain_timeout_s,),
+                         name="ltpu-serve-drain", daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:  # pragma: no cover - non-main thread (embedding)
+        pass
+
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         Log.info("serve: shutting down")
-    finally:
         server.shutdown()
+    finally:
         server.server_close()
+    Log.info("serve: drained and stopped")
     return 0

@@ -513,3 +513,132 @@ def test_large_ingest_memory_bound(tmp_path):
     bound = rep["packed_mb"] + 8 * chunk_raw_mb + 256
     assert increase <= bound, (increase, bound)
     assert bound < raw_mb  # the bound itself rules out the raw matrix
+
+
+class TestBadRowPolicy:
+    """ISSUE-5 satellite: malformed/ragged rows fail loudly naming the
+    file and data-row number under bad_row_policy='error' (the default),
+    are dropped-and-counted under 'skip', and the clean-file path stays
+    bit-identical under both policies."""
+
+    def _write(self, tmp_path, rows, name="d.tsv"):
+        p = str(tmp_path / name)
+        with open(p, "w") as f:
+            f.write("\n".join(rows) + "\n")
+        return p
+
+    def _clean_rows(self, n=400, f=5, seed=11):
+        rng = np.random.RandomState(seed)
+        X = rng.randn(n, f)
+        y = (X[:, 0] > 0).astype(int)
+        return ["\t".join([f"{y[i]:d}"] + [f"{v:.6g}" for v in X[i]])
+                for i in range(n)]
+
+    def test_error_policy_names_file_and_row(self, tmp_path):
+        from lightgbm_tpu.utils.log import LightGBMError
+
+        rows = self._clean_rows(40)
+        rows.insert(7, rows[0] + "\t9.9")  # ragged: extra field at row 8
+        path = self._write(tmp_path, rows)
+        with pytest.raises(LightGBMError) as ei:
+            DenseChunkReader(path, "\t", False).read_all()
+        msg = str(ei.value)
+        assert path in msg and "row 8" in msg and "bad_row_policy" in msg
+
+    def test_skip_policy_drops_and_counts(self, tmp_path):
+        rows = self._clean_rows(60)
+        clean_path = self._write(tmp_path, rows, "clean.tsv")
+        ref, _ = DenseChunkReader(clean_path, "\t", False).read_all()
+        dirty = list(rows)
+        dirty.insert(5, "1\tgarbage\t2\t3\t4\t5")     # unparsable token
+        dirty.insert(20, rows[0] + "\t1\t2")          # extra fields
+        dirty_path = self._write(tmp_path, dirty, "dirty.tsv")
+        r = DenseChunkReader(dirty_path, "\t", False, bad_row_policy="skip")
+        got, _ = r.read_all()
+        assert r.bad_rows == 2
+        np.testing.assert_array_equal(got, ref)  # exactly the clean rows
+
+    def test_clean_file_bit_identical_under_both_policies(self, tmp_path):
+        path = self._write(tmp_path, self._clean_rows(300))
+        a, _ = DenseChunkReader(path, "\t", False).read_all()
+        r = DenseChunkReader(path, "\t", False, bad_row_policy="skip")
+        b, _ = r.read_all()
+        np.testing.assert_array_equal(a, b)
+        assert r.bad_rows == 0
+
+    def test_streaming_ingest_skips_and_trims(self, tmp_path):
+        rows = self._clean_rows(500)
+        dirty = list(rows)
+        dirty.insert(100, "nope\tnope")
+        dirty.insert(300, rows[1] + "\textra")
+        path = self._write(tmp_path, dirty)
+        cfg = Config.from_params({"bad_row_policy": "skip", "verbose": -1})
+        ds = stream_dataset(path, cfg, chunk_rows=128)
+        assert ds.num_data == 500
+        assert ds.ingest_report["bad_rows"] == 2
+        assert ds.ingest_report["rows"] == 500
+        # error policy on the same file names the first bad row
+        from lightgbm_tpu.utils.log import LightGBMError
+
+        cfg_err = Config.from_params({"verbose": -1})
+        with pytest.raises(LightGBMError, match="row 101"):
+            stream_dataset(path, cfg_err, chunk_rows=128)
+
+    def test_streaming_skip_trains_and_matches_clean_rows(self, tmp_path):
+        """The surviving rows bin and train exactly like a file that
+        never had the bad rows (same rows -> same packed matrix)."""
+        rows = self._clean_rows(500)
+        clean = self._write(tmp_path, rows, "c.tsv")
+        dirty = list(rows)
+        dirty.insert(250, "xx\tyy\tzz")
+        dirty_p = self._write(tmp_path, dirty, "d.tsv")
+        cfg = Config.from_params({"bad_row_policy": "skip", "verbose": -1})
+        ds_clean = stream_dataset(clean, cfg, chunk_rows=64)
+        ds_dirty = stream_dataset(dirty_p, cfg, chunk_rows=64)
+        np.testing.assert_array_equal(ds_dirty.binned, ds_clean.binned)
+        np.testing.assert_array_equal(
+            np.asarray(ds_dirty.metadata.label),
+            np.asarray(ds_clean.metadata.label),
+        )
+
+    def test_libsvm_policies(self, tmp_path):
+        from lightgbm_tpu.utils.log import LightGBMError
+
+        rng = np.random.RandomState(3)
+        rows = []
+        for i in range(50):
+            feats = " ".join(f"{j}:{rng.randn():.4g}" for j in range(4))
+            rows.append(f"{i % 2} {feats}")
+        clean = self._write(tmp_path, rows, "c.svm")
+        ref_X, ref_y = LibSVMChunkReader(clean).read_all()
+        dirty = list(rows)
+        dirty.insert(9, "1 0:1.5 broken_token 2:2.0")
+        path = self._write(tmp_path, dirty, "d.svm")
+        with pytest.raises(LightGBMError) as ei:
+            LibSVMChunkReader(path).read_all()
+        assert "row 10" in str(ei.value)
+        r = LibSVMChunkReader(path, bad_row_policy="skip")
+        X, y = r.read_all()
+        assert r.bad_rows == 1
+        np.testing.assert_array_equal(X, ref_X)
+        np.testing.assert_array_equal(y, ref_y)
+
+    def test_obs_counter_counts_skips(self, tmp_path, monkeypatch):
+        from lightgbm_tpu.obs import tracer
+
+        rows = self._clean_rows(50)
+        rows.insert(3, "bad\trow")
+        path = self._write(tmp_path, rows)
+        trace_path = str(tmp_path / "trace.jsonl")
+        tracer.configure(trace_path)
+        try:
+            r = DenseChunkReader(path, "\t", False, bad_row_policy="skip")
+            r.read_all()
+        finally:
+            tracer.close()
+        import json as _json
+
+        recs = [_json.loads(l) for l in open(trace_path)]
+        hits = [r for r in recs
+                if r["ev"] == "counter" and r["name"] == "data.bad_rows"]
+        assert hits and hits[0]["value"] == 1

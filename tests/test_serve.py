@@ -403,3 +403,123 @@ class TestCLI:
         from lightgbm_tpu.cli import main
 
         assert main(["serve"]) == 1
+
+
+class TestReadyAndDrain:
+    """/readyz readiness gating and the SIGTERM graceful drain
+    (docs/ROBUSTNESS.md): ready only after artifact load + warmup,
+    503 while draining, in-flight microbatches finish before exit."""
+
+    @pytest.fixture()
+    def server(self, binary_booster, tmp_path):
+        from lightgbm_tpu.serve.server import make_server
+
+        bst, X = binary_booster
+        path = PredictorArtifact.from_booster(bst).save(str(tmp_path / "m"))
+        srv = make_server(path, port=0, warmup_max_rows=256, max_delay_ms=1.0)
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        yield srv, bst, X
+        srv.shutdown()
+        srv.server_close()
+
+    def _get_code(self, port, path):
+        try:
+            return urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30).status
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    def test_readyz_ready_after_warmup(self, server):
+        srv, _, _ = server
+        port = srv.server_address[1]
+        assert self._get_code(port, "/healthz") == 200
+        assert self._get_code(port, "/readyz") == 200
+        body = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/readyz", timeout=30).read())
+        assert body == {"status": "ready"}
+        st = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats", timeout=30).read())
+        assert st["ready"] is True and st["draining"] is False
+        assert st["inflight"] == 0
+
+    def test_readyz_503_before_ready_and_while_draining(self, server):
+        srv, _, X = server
+        port = srv.server_address[1]
+        srv.ready = False
+        try:
+            assert self._get_code(port, "/readyz") == 503
+            assert self._get_code(port, "/healthz") == 200  # liveness only
+        finally:
+            srv.ready = True
+        srv.draining = True
+        try:
+            assert self._get_code(port, "/readyz") == 503
+            body = "\n".join(
+                json.dumps(list(map(float, r))) for r in X[:2]).encode()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/predict", data=body, timeout=30)
+            assert ei.value.code == 503  # shed-not-queue during drain
+        finally:
+            srv.draining = False
+
+    def test_drain_finishes_inflight_requests(self, binary_booster, tmp_path):
+        import time as _time
+
+        from lightgbm_tpu.serve.server import make_server
+
+        bst, X = binary_booster
+        path = PredictorArtifact.from_booster(bst).save(str(tmp_path / "m"))
+        srv = make_server(path, port=0, warmup_max_rows=64, max_delay_ms=1.0)
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        port = srv.server_address[1]
+        try:
+            orig = srv.predictor.predict
+            srv.batcher.predict_fn = (
+                lambda batch: (_time.sleep(0.4), orig(batch))[1]
+            )
+            result = {}
+
+            def post():
+                body = json.dumps(list(map(float, X[0]))).encode()
+                r = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/predict", data=body, timeout=30)
+                result["code"] = r.status
+                result["pred"] = json.loads(r.read().decode().splitlines()[0])
+
+            th = threading.Thread(target=post)
+            th.start()
+            _time.sleep(0.1)  # the request is now in flight
+            assert srv.drain(5.0) is True  # waits for it, then stops
+            th.join(timeout=10)
+            assert result["code"] == 200  # in-flight work finished, not cut
+            assert result["pred"] == pytest.approx(float(bst.predict(X[:1])[0]))
+            thread.join(timeout=10)
+            assert not thread.is_alive()  # serve_forever exited
+        finally:
+            srv.server_close()
+
+    def test_sigterm_handler_drains(self, binary_booster, tmp_path):
+        """main()'s SIGTERM path end-to-end in-process: the handler
+        thread drains and serve_forever returns."""
+        import time as _time
+
+        from lightgbm_tpu.serve.server import make_server
+
+        bst, _ = binary_booster
+        path = PredictorArtifact.from_booster(bst).save(str(tmp_path / "m2"))
+        srv = make_server(path, port=0, warmup_max_rows=64)
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        try:
+            drainer = threading.Thread(target=srv.drain, args=(5.0,),
+                                       daemon=True)
+            drainer.start()
+            drainer.join(timeout=10)
+            thread.join(timeout=10)
+            assert not thread.is_alive()
+            assert srv.draining is True
+        finally:
+            srv.server_close()
