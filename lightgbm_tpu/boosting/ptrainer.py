@@ -338,16 +338,18 @@ class PartitionedTrainer:
                 if K == 1:
                     if goss_on:
                         # GOSS (goss.hpp:126-198): settle the pending
-                        # delta + fresh gradients first (histogram
-                        # discarded), score |g*h| on the fresh values,
-                        # keep exactly top_cnt rows + a Bernoulli sample
-                        # of the rest up-weighted into g/h, then the real
-                        # pass computes the root histogram of the
-                        # selected/scaled gradients.
+                        # delta + fresh gradients first (histogram-FREE
+                        # pass — the F*B one-hot/matmul accumulation used
+                        # to run here only to be discarded), score |g*h|
+                        # on the fresh values, keep exactly top_cnt rows
+                        # + a Bernoulli sample of the rest up-weighted
+                        # into g/h, then the real pass computes the root
+                        # histogram of the selected/scaled gradients.
                         p, _ = update_and_root_hist(
                             p, lay, grad_fn, delta=delta,
                             num_rows=n, num_features=G, num_bins=BH,
-                            bits=params.bits, interpret=interpret,
+                            bits=params.bits, with_hist=False,
+                            interpret=interpret,
                         )
                         gv = _i2f(p[lay.G, :n])
                         hv = _i2f(p[lay.H, :n])
@@ -455,12 +457,16 @@ class PartitionedTrainer:
             if K == 1:
                 # settle the last tree's delta into the channel so the
                 # score channel is consistent at chunk boundaries (the
-                # in-loop update applies tree t-1's delta at iteration t)
-                p, _ = update_and_root_hist(
-                    p, lay, grad_fn, delta=last_delta, num_rows=n,
-                    num_features=G, num_bins=BH,
-                    bits=params.bits, interpret=interpret,
-                )
+                # in-loop update applies tree t-1's delta at iteration
+                # t).  Score-only band stream: the old settle ran a full
+                # update_and_root_hist — a whole-matrix pass plus an
+                # F*B histogram that was discarded — purely to add the
+                # delta.  The g/h channels stay stale until the next
+                # chunk's first update pass recomputes them from the
+                # settled scores (nothing reads them in between; the
+                # checkpoint exports scores + perm, never g/h).
+                p = score_add(p, lay, last_delta, 0, num_rows=n,
+                              interpret=interpret)
             # original-order scores for eval (K scatters per chunk)
             rowid = p[lay.ROWID, :n]
             outs = []
@@ -1225,13 +1231,14 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
                 raw_t = recs["raw"][t]
                 if K == 1:
                     if goss_on:
-                        # settle pending delta + fresh gradients first,
-                        # then local top-k + Bernoulli rest-sample
-                        # (goss.hpp:126-198 over the shard's rows)
+                        # settle pending delta + fresh gradients first
+                        # (histogram-free pass), then local top-k +
+                        # Bernoulli rest-sample (goss.hpp:126-198 over
+                        # the shard's rows)
                         p, _ = update_and_root_hist(
                             p, lay, grad_fn, delta=delta, num_rows=nl,
                             num_features=G, num_bins=BH, bits=params.bits,
-                            interpret=interpret,
+                            with_hist=False, interpret=interpret,
                         )
                         gv = _i2f(p[lay.G, :nl])
                         hv = _i2f(p[lay.H, :nl])
@@ -1334,11 +1341,9 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
                 0, jnp.minimum(t_run, T), one_iter, carry0
             )
             if K == 1:
-                p, _ = update_and_root_hist(
-                    p, lay, grad_fn, delta=last_delta, num_rows=nl,
-                    num_features=G, num_bins=BH, bits=params.bits,
-                    interpret=interpret,
-                )
+                # score-only chunk-end settle (see the serial trainer)
+                p = score_add(p, lay, last_delta, 0, num_rows=nl,
+                              interpret=interpret)
             rowid = p[lay.ROWID, :nl]
             scores_local = jnp.stack([
                 jnp.zeros((nl,), jnp.float32).at[rowid].set(

@@ -97,6 +97,22 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     return out
 
 
+def top_phases_line(summary: Dict[str, Any], k: int = 3) -> str:
+    """One-line per-phase percentage attribution — the top-``k`` phases
+    by share of total phase time, e.g.
+    ``top phases: partition 61.2% | histogram 22.4% | split 9.8%``.
+    Shares are of the summed PHASE time (not iteration wall) so the line
+    is meaningful for partial traces too.  Empty string when the trace
+    has no phase records."""
+    phases = summary.get("phases") or {}
+    total = sum(v["total_s"] for v in phases.values())
+    if not phases or total <= 0:
+        return ""
+    ranked = sorted(phases.items(), key=lambda kv: -kv[1]["total_s"])[:k]
+    parts = [f"{name} {100.0 * v['total_s'] / total:.1f}%" for name, v in ranked]
+    return "top phases: " + " | ".join(parts)
+
+
 def render(summary: Dict[str, Any], path: str = "") -> str:
     """TIMETAG-style text table."""
     lines = []
@@ -111,6 +127,12 @@ def render(summary: Dict[str, Any], path: str = "") -> str:
         lines.append("iterations: 0 (no iter records — run died before training?)")
     total_wall = summary["total_iter_wall_s"] or 0.0
     if summary["phases"]:
+        # one-line attribution: top-3 phases by share of iteration wall,
+        # so "where does the time go" doesn't require reading the table
+        # (or the raw JSONL)
+        top = top_phases_line(summary)
+        if top:
+            lines.append(top)
         lines.append("")
         lines.append(f"{'phase (per-iteration)':<28}{'total_s':>10}{'count':>8}"
                      f"{'mean_ms':>10}{'% iter':>8}")

@@ -25,6 +25,7 @@ Output: (F, B, 3) f32 of (sum_grad, sum_hess, count) per (feature, bin).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -32,12 +33,62 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 # Columns (rows of data) per grid step.  The one-hot chunk is
-# (FCHUNK*B, BLK) f32; BLK=1024 with FCHUNK*B<=512 keeps it ~2 MB.
+# (FCHUNK*B, BLK) bf16; BLK=1024 with FCHUNK*B<=1024 keeps it <=2 MB.
 BLK = 1024
+_LANE = 128  # MXU/DMA lane quantum
 
 
-def _hist_kernel(lohi_ref, p_ref, out_ref, acc_ref, *, nf, nb, w_words, per, bits, fchunk):
+def tune_fchunk(num_features: int, num_bins: int,
+                max_tile_bytes: int = 2 * 1024 * 1024) -> int:
+    """Feature-chunk width for the one-hot histogram dots, tuned against
+    the (bin-count, feature-count) shape instead of the old fixed
+    ``512 // num_bins`` rule.
+
+    The kernel builds the bin one-hots as an (fchunk*B, BLK) bf16 tile
+    and contracts it on the MXU.  Per 1024-row block the estimated cost
+    is sum over chunks of roundup(chunk*B, 128) MXU rows (the systolic
+    array pads the non-contracting dim to the 128-lane quantum) plus a
+    fixed per-dot issue overhead — so the tuner prefers chunk widths
+    whose row count is 128-aligned AND divide the feature count evenly
+    (no ragged tail tile), under a VMEM tile budget.  Bit-safety: fchunk
+    only groups which (feature, bin) cells share one dot_general; each
+    cell still contracts the same BLK lanes in the same order, so ANY
+    fchunk produces bit-identical histograms.
+
+    ``LIGHTGBM_TPU_HIST_FCHUNK`` overrides (clamped to [1, F]); the
+    split/level kernels call with a smaller ``max_tile_bytes`` because
+    their VMEM is already crowded by the partition stream buffers.
+    """
+    env = os.environ.get("LIGHTGBM_TPU_HIST_FCHUNK", "")
+    if env:
+        try:
+            return max(1, min(num_features, int(env)))
+        except ValueError:
+            pass
+    cap = max(1, min(num_features, max_tile_bytes // max(num_bins * BLK * 2, 1)))
+    best = max(
+        range(1, cap + 1),
+        key=lambda f: (-fchunk_cost(num_features, num_bins, f), f),
+    )
+    return best
+
+
+def fchunk_cost(num_features: int, num_bins: int, fchunk: int) -> int:
+    """Estimated per-block MXU row cost of a feature-chunk width: sum of
+    128-padded one-hot rows over chunks plus a fixed per-dot issue
+    overhead.  Exposed for the bench kernel A/B report."""
+    cost, rem, chunks = 0, num_features, 0
+    while rem > 0:
+        c = min(fchunk, rem)
+        rem -= c
+        chunks += 1
+        cost += -(-c * num_bins // _LANE) * _LANE
+    return cost + chunks * 256  # per-dot issue overhead (~2 lane rows)
+
+
+def _hist_kernel(lohi_ref, p_ref, out_ref, acc_ref, *, nf, nb, rows, per, bits, fchunk):
     j = pl.program_id(0)
+    g_row, h_row, sel_row = rows
 
     @pl.when(j == 0)
     def _init():
@@ -45,9 +96,9 @@ def _hist_kernel(lohi_ref, p_ref, out_ref, acc_ref, *, nf, nb, w_words, per, bit
 
     pos = jax.lax.broadcasted_iota(jnp.int32, (1, BLK), 1) + j * BLK
     valid = ((pos >= lohi_ref[0]) & (pos < lohi_ref[1])).astype(jnp.float32)
-    g = pltpu.bitcast(p_ref[w_words : w_words + 1, :], jnp.float32)
-    h = pltpu.bitcast(p_ref[w_words + 1 : w_words + 2, :], jnp.float32)
-    sel = pltpu.bitcast(p_ref[w_words + 2 : w_words + 3, :], jnp.float32) * valid
+    g = pltpu.bitcast(p_ref[g_row : g_row + 1, :], jnp.float32)
+    h = pltpu.bitcast(p_ref[h_row : h_row + 1, :], jnp.float32)
+    sel = pltpu.bitcast(p_ref[sel_row : sel_row + 1, :], jnp.float32) * valid
     gs = g * sel
     hs = h * sel
 
@@ -94,7 +145,8 @@ def _hist_kernel(lohi_ref, p_ref, out_ref, acc_ref, *, nf, nb, w_words, per, bit
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_features", "num_bins", "per", "bits")
+    jax.jit,
+    static_argnames=("num_features", "num_bins", "per", "bits", "rows", "interpret"),
 )
 def hist_segment(
     p: jnp.ndarray,
@@ -104,20 +156,25 @@ def hist_segment(
     num_bins: int,
     per: int = 4,
     bits: int = 8,
+    rows: tuple = None,
+    interpret: bool = False,
 ) -> jnp.ndarray:
     """(F, B, 3) histogram of columns [lo, hi) of the packed matrix ``p``.
 
     p : (C, S) int32, S a multiple of BLK — see module docstring.
     lo, hi : int32 scalars — the valid column range (the leaf's segment,
       relative to this slice).  Columns outside contribute zero.
+    rows : optional (g, h, sel) channel-row triple for matrices whose
+      value rows are NOT at W..W+2 (the pgrow packed layout pads the bin
+      words to 8 sublanes — pass ``PLayout.rows``).
     """
     c, s = p.shape
     assert s % BLK == 0, f"segment length {s} not a multiple of {BLK}"
-    w_words = -(-num_features // per)
+    if rows is None:
+        w_words = -(-num_features // per)
+        rows = (w_words, w_words + 1, w_words + 2)
     fb = num_features * num_bins
-    # chunk features so the one-hot tile stays ~<=2MB and row count is a
-    # multiple of 128 where possible
-    fchunk = max(1, min(num_features, 512 // num_bins))
+    fchunk = tune_fchunk(num_features, num_bins)
 
     lohi = jnp.stack([lo.astype(jnp.int32), hi.astype(jnp.int32)])
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -136,13 +193,14 @@ def hist_segment(
             _hist_kernel,
             nf=num_features,
             nb=num_bins,
-            w_words=w_words,
+            rows=rows,
             per=per,
             bits=bits,
             fchunk=fchunk,
         ),
         out_shape=jax.ShapeDtypeStruct((fb, 7), jnp.float32),
         grid_spec=grid_spec,
+        interpret=interpret,
     )(lohi, p)
     # re-sum the 3-term splits: (sum_g, sum_h, count)
     hist = jnp.stack(
@@ -154,6 +212,181 @@ def hist_segment(
         axis=1,
     )
     return hist.reshape(num_features, num_bins, 3)
+
+
+# ======================================================================
+# hist_segments: multi-leaf segmented histograms, ONE kernel launch
+# ======================================================================
+def _hist_multi_kernel(sref, p_any, hist_out, acc2, buf_ref, rsem, hsem, *,
+                       nf, nb, rows, c, fchunk, bits, fbp):
+    """All ``n_active`` leaf segments' (F, B) histograms in one launch.
+
+    Per-segment streaming copies _hist_kernel's double-buffered DMA
+    pattern (ops/pkernels._hist_kernel); per-segment (8, F*B) results
+    are DMA'd to the output double-buffered while the next segment
+    streams — the per-leaf kernel-launch fixed cost (~0.3 ms measured on
+    the tunneled runtime) collapses to one launch per LEVEL.
+
+    sref: (1 + smax, 2) int32 — row 0 holds [n_active, 0]; row 1+s holds
+    segment s's [start, cnt]."""
+    n_active = sref[0, 0]
+    g_row, h_row, sel_row = rows
+    per = 32 // bits
+    mask = (1 << bits) - 1
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (nb, BLK), 0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, BLK), 1)
+
+    def one_seg(s, _):
+        slot = jax.lax.rem(s, 2)
+
+        # wait for the DMA that used this accumulator slot two segments ago
+        @pl.when(s >= 2)
+        def _():
+            pltpu.make_async_copy(acc2.at[slot], acc2.at[slot], hsem.at[slot]).wait()
+
+        acc2[slot] = jnp.zeros_like(acc2[slot])
+        acc = acc2.at[slot]
+        start = sref[1 + s, 0]
+        cnt = sref[1 + s, 1]
+        base = pl.multiple_of((start // BLK) * BLK, _LANE)
+        head = start - base
+        nblk = (head + cnt + BLK - 1) // BLK
+
+        def get_dma(bslot, j):
+            return pltpu.make_async_copy(
+                p_any.at[:, pl.ds(base + j * BLK, BLK)], buf_ref.at[bslot],
+                rsem.at[bslot],
+            )
+
+        @pl.when(nblk > 0)
+        def _():
+            get_dma(0, 0).start()
+
+        def body(j, _):
+            bslot = jax.lax.rem(j, 2)
+
+            @pl.when(j + 1 < nblk)
+            def _():
+                get_dma(1 - bslot, j + 1).start()
+
+            get_dma(bslot, j).wait()
+            blk = buf_ref[bslot]
+            pos = lane + j * BLK
+            valid = ((pos >= head) & (pos < head + cnt)).astype(jnp.float32)
+            sel = pltpu.bitcast(blk[sel_row : sel_row + 1, :], jnp.float32) * valid
+            g = pltpu.bitcast(blk[g_row : g_row + 1, :], jnp.float32) * sel
+            h = pltpu.bitcast(blk[h_row : h_row + 1, :], jnp.float32) * sel
+
+            def split3(x):
+                x_hi = x.astype(jnp.bfloat16)
+                r1 = x - x_hi.astype(jnp.float32)
+                x_mid = r1.astype(jnp.bfloat16)
+                x_lo = (r1 - x_mid.astype(jnp.float32)).astype(jnp.bfloat16)
+                return [x_hi, x_mid, x_lo]
+
+            vals = jnp.concatenate(
+                split3(g) + split3(h) + [sel.astype(jnp.bfloat16)], axis=0
+            )
+            for c0 in range(0, nf, fchunk):
+                c1 = min(c0 + fchunk, nf)
+                chunks = []
+                for f in range(c0, c1):
+                    wd, p4 = divmod(f, per)
+                    byte = (blk[wd : wd + 1, :] >> (p4 * bits)) & mask
+                    chunks.append((byte == iota_b).astype(jnp.bfloat16))
+                oh = jnp.concatenate(chunks, axis=0)
+                acc[0:7, c0 * nb : c1 * nb] += jax.lax.dot_general(
+                    vals, oh, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            return 0
+
+        jax.lax.fori_loop(0, nblk, body, 0)
+        pltpu.make_async_copy(acc2.at[slot], hist_out.at[s], hsem.at[slot]).start()
+        return 0
+
+    jax.lax.fori_loop(0, n_active, one_seg, 0)
+
+    @pl.when(n_active >= 1)
+    def _():
+        slot = jax.lax.rem(n_active - 1, 2)
+        pltpu.make_async_copy(acc2.at[slot], acc2.at[slot], hsem.at[slot]).wait()
+
+    @pl.when(n_active >= 2)
+    def _():
+        slot = jax.lax.rem(n_active - 2, 2)
+        pltpu.make_async_copy(acc2.at[slot], acc2.at[slot], hsem.at[slot]).wait()
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_features", "num_bins", "bits", "rows", "smax", "interpret"),
+)
+def hist_segments(
+    p: jnp.ndarray,
+    seg_tab: jnp.ndarray,
+    n_active,
+    *,
+    num_features: int,
+    num_bins: int,
+    bits: int = 8,
+    rows: tuple = None,
+    smax: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """(smax, F, B, 3) histograms of ``n_active`` leaf segments of the
+    packed matrix ``p`` in ONE kernel launch — the multi-leaf form of
+    ``hist_segment`` for level-batched growers (one launch covers every
+    active leaf of a tree level instead of one launch per leaf).
+
+    seg_tab : (smax, 2) int32 rows of [start, cnt] (disjoint segments).
+      Output rows for s >= n_active are undefined.  ``p`` must
+      have enough tail columns that every segment's covering BLK-blocks
+      exist (the pgrow packed matrix carries a BLK tail for exactly
+      this; otherwise pad columns to the next BLK multiple).
+    rows : (g, h, sel) channel-row triple; defaults to the plain
+      pack_columns layout (W, W+1, W+2).
+    """
+    c = p.shape[0]
+    per = 32 // bits
+    if rows is None:
+        w_words = -(-num_features // per)
+        rows = (w_words, w_words + 1, w_words + 2)
+    fb = num_features * num_bins
+    fbp = -(-fb // _LANE) * _LANE  # sliced VMEM refs must be lane-aligned
+    fchunk = tune_fchunk(num_features, num_bins)
+    hdr = jnp.zeros((1, 2), jnp.int32).at[0, 0].set(jnp.int32(n_active))
+    sv = jnp.concatenate([hdr, seg_tab.astype(jnp.int32)], axis=0)
+    out = pl.pallas_call(
+        functools.partial(
+            _hist_multi_kernel, nf=num_features, nb=num_bins, rows=rows,
+            c=c, fchunk=fchunk, bits=bits, fbp=fbp,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(1,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[
+                pltpu.VMEM((2, 8, fbp), jnp.float32),  # double-buffered acc
+                pltpu.VMEM((2, c, BLK), jnp.int32),  # stream buffers
+                pltpu.SemaphoreType.DMA((2,)),  # read sem
+                pltpu.SemaphoreType.DMA((2,)),  # hist-out sem
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((smax, 8, fbp), jnp.float32),
+        interpret=interpret,
+    )(sv, p)
+    out = out[:, :, :fb]
+    hist = jnp.stack(
+        [
+            out[:, 0] + (out[:, 1] + out[:, 2]),
+            out[:, 3] + (out[:, 4] + out[:, 5]),
+            out[:, 6],
+        ],
+        axis=2,
+    )  # (smax, F*B, 3)
+    return hist.reshape(smax, num_features, num_bins, 3)
 
 
 def pack_columns(

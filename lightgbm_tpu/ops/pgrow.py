@@ -38,6 +38,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from .histogram_pallas import hist_segments
 from .pkernels import (
     BLK,
     PLayout,
@@ -182,7 +183,8 @@ def _meta_table(meta: FeatureMeta, bmeta, f: int, bits: int) -> jnp.ndarray:
     return jnp.stack([db, cat, col, off_lo, off_hi, bias, z, z], axis=1)
 
 
-@functools.partial(jax.jit, static_argnames=("params", "interpret", "rows"))
+@functools.partial(jax.jit, static_argnames=("params", "interpret", "rows"),
+                   donate_argnums=(0,))
 def grow_tree_partitioned(
     p: jnp.ndarray,
     feature_mask: jnp.ndarray,
@@ -250,8 +252,19 @@ def grow_tree_partitioned(
         return res._replace(gain=jnp.where(depth_ok, res.gain, NEG_INF))
 
     if root_hist is None:
-        root_hist = hist_dyn(p, 0, n, G, BH, bits=params.bits, rows=rows,
-                             interpret=interpret)
+        if levelwise:
+            # multi-leaf segmented histogram kernel (one launch covers a
+            # whole level's segments; the root is level 0's single
+            # segment) — bit-identical to hist_dyn: same per-block
+            # accumulation order, same fchunk tuning, same 3-term re-sum
+            seg0_tab = jnp.zeros((8, 2), jnp.int32).at[0, 1].set(n)
+            root_hist = hist_segments(
+                p, seg0_tab, 1, num_features=G, num_bins=BH,
+                bits=params.bits, rows=rows, smax=8, interpret=interpret,
+            )[0]
+        else:
+            root_hist = hist_dyn(p, 0, n, G, BH, bits=params.bits, rows=rows,
+                                 interpret=interpret)
         if params.axis_name:
             root_hist = jax.lax.psum(root_hist, params.axis_name)
     # (callers passing root_hist in data-parallel mode psum it themselves)
@@ -535,6 +548,29 @@ def grow_tree_partitioned(
         rec_internal_value=recs[:, 9],
     )
     return res, st.p
+
+
+def level_hists(p, seg_tab, n_active, params: PGrowParams, rows=None,
+                interpret: bool = False):
+    """(smax, G, BH, 3) histograms of every active leaf segment of a
+    level in ONE kernel launch (ops/histogram_pallas.hist_segments) —
+    the multi-leaf replacement for a per-leaf hist_dyn launch loop.
+
+    The fused grower normally gets level histograms for free from
+    ``level_stream``'s partition pass; this helper serves callers that
+    need segment histograms OUTSIDE a partition (root histograms, the
+    kernel A/B harness in bench.py, numerics tripwires), at one launch
+    per level instead of one per leaf.  seg_tab: (smax, 2) int32 rows of
+    [start, cnt]."""
+    G = params.num_cols or params.num_features
+    BH = params.num_bins_hist or params.num_bins
+    if rows is None:
+        rows = PLayout(G, bits=params.bits).rows
+    smax = int(seg_tab.shape[0])
+    return hist_segments(
+        p, seg_tab, n_active, num_features=G, num_bins=BH,
+        bits=params.bits, rows=rows, smax=smax, interpret=interpret,
+    )
 
 
 def segment_values(tree: PTreeResult, num_rows: int, values: jnp.ndarray) -> jnp.ndarray:

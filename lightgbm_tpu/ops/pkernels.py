@@ -27,9 +27,17 @@ tunneled runtime):
   1. ANY XLA-level write to the 64 MB packed matrix — even a one-element
      `.at[0,0].add(1)` on a donated loop carry — triggers a pathological
      whole-array copy costing 50-180 ms.  Only Pallas kernels with
-     ``input_output_aliases`` mutate it truly in place.  Hence
-     ``update_channels``: gradients / bagging / score updates stream the
-     mutable band through VMEM and write it back aliased.
+     ``input_output_aliases`` mutate it truly in place.  The resolution
+     is a carry-layout contract, not donation avoidance: the matrix
+     travels the fused loop carry untouched by XLA ops (every mutation
+     is an aliased Pallas pass; all scalar/per-leaf bookkeeping lives in
+     SEPARATE small carry arrays), and the jitted kernel entry points
+     here (``split_stream``/``level_stream``/``score_add``) carry
+     ``donate_argnums=(0,)`` so standalone calls alias straight through
+     instead of paying a defensive input copy.  ``update_channels`` /
+     ``score_add`` stream only the 8-aligned mutable band for
+     score/gradient maintenance — the bin words are never re-read or
+     re-written by a pass that doesn't need them.
   2. The kernels are VPU-compute-bound, not HBM-bound: the (B, BLK)
      bin-equality one-hots and the (BLK, BLK) permutation one-hots cost
      ~1 us per 64 compares/lane-block, while the DMA itself is tens of
@@ -75,6 +83,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from .histogram_pallas import tune_fchunk
 
 BLK = 1024  # columns (data rows) per streamed chunk
 _LANE = 128  # DMA lane-alignment quantum
@@ -335,7 +345,7 @@ def hist_dyn(p, start, cnt, num_features, num_bins, bits=8, rows=None, interpret
         rows = (wpad, wpad + 1, wpad + 2)
     c = p.shape[0]
     fb = num_features * num_bins
-    fchunk = max(1, min(num_features, 512 // num_bins))
+    fchunk = tune_fchunk(num_features, num_bins)
     out = pl.pallas_call(
         functools.partial(_hist_kernel, nf=num_features, nb=num_bins, rows=rows, c=c,
                           fchunk=fchunk, bits=bits),
@@ -362,7 +372,7 @@ def hist_dyn(p, start, cnt, num_features, num_bins, bits=8, rows=None, interpret
 def _upd_hist_kernel(sref, aux_any, p_any_in, p_any, o_ref, acc_ref, buf_ref, abuf,
                      stage, rsem, asem, wsem, sem_unused, *, nf, nb, rows, c,
                      fchunk, bits, grad_fn, lay_rows, use_sel, use_mul,
-                     use_weight, n_delta, n_score, k_grad):
+                     use_weight, n_delta, n_score, k_grad, with_hist=True):
     """One streaming pass over ALL rows: score += delta, (g, h) =
     grad_fn(score, label, weight), select = sel, block written back in
     place, AND the root (F, B, 3) histogram accumulated from the fresh
@@ -375,7 +385,8 @@ def _upd_hist_kernel(sref, aux_any, p_any_in, p_any, o_ref, acc_ref, buf_ref, ab
     g_row, h_row, sel_row = rows
     G_, H_, SEL_, SCORE_, LABEL_, ROWID_, WEIGHT_ = lay_rows
     nblk = (n + BLK - 1) // BLK
-    acc_ref[:, :] = jnp.zeros_like(acc_ref)
+    if with_hist:
+        acc_ref[:, :] = jnp.zeros_like(acc_ref)
 
     def get_dma(slot, j):
         return pltpu.make_async_copy(
@@ -439,50 +450,62 @@ def _upd_hist_kernel(sref, aux_any, p_any_in, p_any, o_ref, acc_ref, buf_ref, ab
             out = _setrow(out, SCORE_, pltpu.bitcast(scores, jnp.int32))
         _stream_flush(stage, wsem, p_any, out, j, j * BLK)
 
-        # ---- root histogram from the fresh values
-        pos = lane + j * BLK
-        valid = (pos < n).astype(jnp.float32)
-        sel = selv * valid
-        g = gv * sel
-        h = hv * sel
-        vals = jnp.concatenate(
-            _split3(g) + _split3(h) + [sel.astype(jnp.bfloat16)], axis=0
-        )
-        per = 32 // bits
-        mask = (1 << bits) - 1
-        for c0 in range(0, nf, fchunk):
-            c1 = min(c0 + fchunk, nf)
-            chunks = []
-            for f in range(c0, c1):
-                wd, p4 = divmod(f, per)
-                byte = (blk[wd : wd + 1, :] >> (p4 * bits)) & mask
-                chunks.append((byte == iota_b).astype(jnp.bfloat16))
-            oh = jnp.concatenate(chunks, axis=0)
-            acc_ref[0:7, c0 * nb : c1 * nb] += jax.lax.dot_general(
-                vals, oh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        # ---- root histogram from the fresh values (skipped entirely for
+        # histogram-free passes — GOSS's gradient-prep pass used to pay
+        # the full F*B one-hot/matmul accumulation only to discard it)
+        if with_hist:
+            pos = lane + j * BLK
+            valid = (pos < n).astype(jnp.float32)
+            sel = selv * valid
+            g = gv * sel
+            h = hv * sel
+            vals = jnp.concatenate(
+                _split3(g) + _split3(h) + [sel.astype(jnp.bfloat16)], axis=0
             )
+            per = 32 // bits
+            mask = (1 << bits) - 1
+            for c0 in range(0, nf, fchunk):
+                c1 = min(c0 + fchunk, nf)
+                chunks = []
+                for f in range(c0, c1):
+                    wd, p4 = divmod(f, per)
+                    byte = (blk[wd : wd + 1, :] >> (p4 * bits)) & mask
+                    chunks.append((byte == iota_b).astype(jnp.bfloat16))
+                oh = jnp.concatenate(chunks, axis=0)
+                acc_ref[0:7, c0 * nb : c1 * nb] += jax.lax.dot_general(
+                    vals, oh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+                )
         return 0
 
     jax.lax.fori_loop(0, nblk, body, 0)
     _stream_drain(stage, wsem, nblk)
-    o_ref[:, :] = acc_ref[:, :]
+    if with_hist:
+        o_ref[:, :] = acc_ref[:, :]
+    else:
+        o_ref[:, :] = jnp.zeros_like(o_ref)
 
 
 def update_and_root_hist(p, layout: PLayout, grad_fn, delta=None, sel=None,
                          mul=None, *, num_rows, num_features, num_bins,
-                         bits=8, rows=None, interpret: bool = False):
+                         bits=8, rows=None, with_hist: bool = True,
+                         interpret: bool = False):
     """Fused per-iteration channel maintenance + root histogram: ONE
     streaming pass writes score += delta, fresh (g, h), bagging select —
     in place via input_output_aliases — and returns the root (F, B, 3)
     histogram of the fresh values (the fused trainer starts every tree
     with exactly this pair).  GBDT::Boosting + Bagging + the root
-    ConstructHistogram in one pass (gbdt.cpp:692-700, 275-334)."""
+    ConstructHistogram in one pass (gbdt.cpp:692-700, 275-334).
+
+    ``with_hist=False`` runs the identical channel update (bit-for-bit
+    the same matrix writes) with the histogram accumulation compiled
+    out and returns (p, None) — the GOSS gradient-prep pass, which used
+    to pay the full F*B one-hot/matmul work only to discard it."""
     if rows is None:
         rows = layout.rows
     ntot = p.shape[1]
     c = p.shape[0]
     fb = num_features * num_bins
-    fchunk = max(1, min(num_features, 512 // num_bins))
+    fchunk = tune_fchunk(num_features, num_bins)
 
     def fit(v):
         v = jnp.asarray(v, jnp.float32)
@@ -514,6 +537,7 @@ def update_and_root_hist(p, layout: PLayout, grad_fn, delta=None, sel=None,
         fchunk=fchunk, bits=bits, grad_fn=grad_fn, lay_rows=lay_rows,
         use_sel=use_sel, use_mul=use_mul, use_weight=layout.with_weight,
         n_delta=n_delta, n_score=layout.num_score, k_grad=0,
+        with_hist=with_hist,
     )
     p, out = pl.pallas_call(
         kern,
@@ -546,6 +570,8 @@ def update_and_root_hist(p, layout: PLayout, grad_fn, delta=None, sel=None,
         input_output_aliases={2: 0},
         interpret=interpret,
     )(jnp.stack([jnp.int32(num_rows)]), aux, p)
+    if not with_hist:
+        return p, None
     return p, _hist_from_rows(out, num_features, num_bins)
 
 
@@ -655,7 +681,7 @@ def update_multi_and_hists(p, layout: PLayout, grad_all_fn, sel=None,
     ntot = p.shape[1]
     c = p.shape[0]
     fb = num_features * num_bins
-    fchunk = max(1, min(num_features, 512 // num_bins))
+    fchunk = tune_fchunk(num_features, num_bins)
     nv = 6 * K + 1
     nvpad = -(-nv // 8) * 8
 
@@ -713,83 +739,111 @@ def update_multi_and_hists(p, layout: PLayout, grad_all_fn, sel=None,
 
 
 # ======================================================================
-# score_add: in-place score-row segment update (multiclass per-tree)
+# score_add: in-place score-row segment update (multiclass per-tree,
+# chunk-end settle, traced score_update)
 # ======================================================================
-def _score_add_kernel(sref, aux_any, p_any_in, p_any, buf_ref, abuf,
-                      stage, rsem, asem, wsem, *, c, score_row):
-    n = sref[0]
-    nblk = (n + BLK - 1) // BLK
+def _score_band_kernel(aux_any, p_in, p_any, buf, abuf, rsem, asem, wsem, *,
+                       band0, bandn, nblk, score_off):
+    """Band-streaming score update: score += delta touching ONLY the
+    8-aligned mutable band (``update_channels``' ring pattern).  The old
+    kernel streamed every matrix row — including the packed bin words —
+    just to rewrite them unchanged; reading the band alone halves (or
+    better) the traffic of every score-only pass and leaves the bin/rowid
+    rows genuinely untouched ("read once per round")."""
+    R, K = _URING, _UAHEAD
 
-    def get_dma(slot, j):
+    def rd(j):
+        sl = jax.lax.rem(j, R)
         return pltpu.make_async_copy(
-            p_any.at[:, pl.ds(j * BLK, BLK)], buf_ref.at[slot], rsem.at[slot]
+            p_any.at[band0 : band0 + bandn, pl.ds(j * BLK, BLK)], buf.at[sl], rsem.at[sl]
         )
 
-    def get_aux(slot, j):
+    def rda(j):
+        sl = jax.lax.rem(j, R)
         return pltpu.make_async_copy(
-            aux_any.at[:, pl.ds(j * BLK, BLK)], abuf.at[slot], asem.at[slot]
+            aux_any.at[:, pl.ds(j * BLK, BLK)], abuf.at[sl], asem.at[sl]
         )
 
-    get_dma(0, 0).start()
-    get_aux(0, 0).start()
+    def wr(j):
+        sl = jax.lax.rem(j, R)
+        return pltpu.make_async_copy(
+            buf.at[sl], p_any.at[band0 : band0 + bandn, pl.ds(j * BLK, BLK)], wsem.at[sl]
+        )
+
+    for k in range(min(K, nblk)):
+        rd(k).start()
+        rda(k).start()
 
     def body(j, _):
-        slot = jax.lax.rem(j, 2)
+        sl = jax.lax.rem(j, R)
+        rd(j).wait()
+        rda(j).wait()
+        blk = buf[sl]
+        sc = pltpu.bitcast(blk[score_off : score_off + 1, :], jnp.float32)
+        sc = sc + abuf[sl][0:1, :]
+        buf[sl] = _setrow(blk, score_off, pltpu.bitcast(sc, jnp.int32))
+        wr(j).start()
 
-        @pl.when(j + 1 < nblk)
+        @pl.when(j + K < nblk)
         def _():
-            get_dma(1 - slot, j + 1).start()
-            get_aux(1 - slot, j + 1).start()
+            @pl.when(j + K - R >= 0)
+            def _():
+                wr(j + K - R).wait()
 
-        get_dma(slot, j).wait()
-        get_aux(slot, j).wait()
-        blk = buf_ref[slot]
-        sc = pltpu.bitcast(blk[score_row : score_row + 1, :], jnp.float32)
-        sc = sc + abuf[slot][0:1, :]
-        out = _setrow(blk, score_row, pltpu.bitcast(sc, jnp.int32))
-        _stream_flush(stage, wsem, p_any, out, j, j * BLK)
+            rd(j + K).start()
+            rda(j + K).start()
+
         return 0
 
     jax.lax.fori_loop(0, nblk, body, 0)
-    _stream_drain(stage, wsem, nblk)
+    for k in range(min(_URING, nblk)):
+        wr(nblk - 1 - k).wait()
 
 
+@functools.partial(jax.jit, static_argnames=("layout", "k", "num_rows", "interpret"),
+                   donate_argnums=(0,))
 def score_add(p, layout: PLayout, delta, k: int = 0, *, num_rows,
               interpret: bool = False):
     """score channel k += delta (N,) in place — the per-tree score update
     of the multiclass fused loop (applied IMMEDIATELY after each tree,
-    while the delta's row layout is still current)."""
+    while the delta's row layout is still current) and the chunk-end
+    pending-delta settle.  Streams only the mutable band, not the full
+    matrix; donated at the jit level so standalone calls never pay a
+    defensive whole-matrix copy."""
     ntot = p.shape[1]
-    c = p.shape[0]
     v = jnp.asarray(delta, jnp.float32)
     pad = ntot - v.shape[0]
     if pad:
         v = jnp.concatenate([v, jnp.zeros((pad,), jnp.float32)])
     aux = jnp.concatenate([v[None, :], jnp.zeros((7, ntot), jnp.float32)], axis=0)
-    kern = functools.partial(_score_add_kernel, c=c, score_row=layout.SCORE + k)
+    nblk = (int(num_rows) + BLK - 1) // BLK
+    band0, bandn = layout.WPAD, layout.BAND
+    kern = functools.partial(
+        _score_band_kernel, band0=band0, bandn=bandn, nblk=nblk,
+        score_off=layout.SCORE + k - band0,
+    )
     return pl.pallas_call(
         kern,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=0,
             grid=(1,),
             in_specs=[
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),  # aux
+                pl.BlockSpec(memory_space=pl.ANY),  # P (alias)
             ],
             out_specs=pl.BlockSpec(memory_space=pl.ANY),
             scratch_shapes=[
-                pltpu.VMEM((2, c, BLK), jnp.int32),
-                pltpu.VMEM((2, 8, BLK), jnp.float32),
-                pltpu.VMEM((2, c, BLK), jnp.int32),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.VMEM((_URING, bandn, BLK), jnp.int32),
+                pltpu.VMEM((_URING, 8, BLK), jnp.float32),
+                pltpu.SemaphoreType.DMA((_URING,)),
+                pltpu.SemaphoreType.DMA((_URING,)),
+                pltpu.SemaphoreType.DMA((_URING,)),
             ],
         ),
         out_shape=jax.ShapeDtypeStruct(p.shape, jnp.int32),
-        input_output_aliases={2: 0},
+        input_output_aliases={1: 0},
         interpret=interpret,
-    )(jnp.stack([jnp.int32(num_rows)]), aux, p)
+    )(aux, p)
 
 
 # ======================================================================
@@ -1226,7 +1280,8 @@ def _level_kernel(
         pltpu.make_async_copy(hacc.at[slot], hacc.at[slot], hsem.at[slot]).wait()
 
 
-@functools.partial(jax.jit, static_argnames=("num_features", "num_bins", "bits", "rows", "smax", "interpret"))
+@functools.partial(jax.jit, static_argnames=("num_features", "num_bins", "bits", "rows", "smax", "interpret"),
+                   donate_argnums=(0,))
 def level_stream(p, seg_tab, n_active, *, num_features, num_bins, bits=8,
                  rows=None, smax, interpret=False):
     """Partition all ``n_active`` leaf segments described by ``seg_tab``
@@ -1245,7 +1300,10 @@ def level_stream(p, seg_tab, n_active, *, num_features, num_bins, bits=8,
     fb = num_features * num_bins
     # sliced VMEM refs (hacc.at[slot]) must be lane-tile (128) aligned
     fbp = -(-fb // _LANE) * _LANE
-    fchunk = max(1, min(num_features, 512 // num_bins))
+    # split/level kernels: VMEM is crowded by the partition stream
+    # buffers, so cap the one-hot tile at the historical 1 MiB
+    fchunk = tune_fchunk(num_features, num_bins,
+                         max_tile_bytes=1024 * 1024)
     hdr = jnp.zeros((1, 12), jnp.int32).at[0, 0].set(jnp.int32(n_active))
     sv = jnp.concatenate([hdr, seg_tab.astype(jnp.int32)], axis=0)
     p, hist, nl = pl.pallas_call(
@@ -1289,7 +1347,8 @@ def level_stream(p, seg_tab, n_active, *, num_features, num_bins, bits=8,
     return p, nl, hist[:, :, :fb]
 
 
-@functools.partial(jax.jit, static_argnames=("num_features", "num_bins", "bits", "rows", "interpret"))
+@functools.partial(jax.jit, static_argnames=("num_features", "num_bins", "bits", "rows", "interpret"),
+                   donate_argnums=(0,))
 def split_stream(p, start, cnt, word, shift, zero_bin, dbz, thr, is_cat,
                  off_lo=0, off_hi=256, bias=0, *, num_features, num_bins,
                  bits=8, rows=None, interpret=False):
@@ -1305,7 +1364,10 @@ def split_stream(p, start, cnt, word, shift, zero_bin, dbz, thr, is_cat,
         rows = (wpad, wpad + 1, wpad + 2)
     c = p.shape[0]
     fb = num_features * num_bins
-    fchunk = max(1, min(num_features, 512 // num_bins))
+    # split/level kernels: VMEM is crowded by the partition stream
+    # buffers, so cap the one-hot tile at the historical 1 MiB
+    fchunk = tune_fchunk(num_features, num_bins,
+                         max_tile_bytes=1024 * 1024)
     sv = jnp.stack(
         [
             jnp.int32(start), jnp.int32(cnt), jnp.int32(word), jnp.int32(shift),
@@ -1468,8 +1530,10 @@ def update_channels(p, layout: PLayout, grad_fn, delta=None, sel=None,
     Exists because ANY XLA-level write to the big matrix (even a
     one-element update on a donated loop carry) costs a pathological
     whole-array copy on this backend; only Pallas input_output_aliases
-    mutate in place.  ``delta``/``sel`` are (N,)-or-longer f32 vectors
-    (padded with zeros up to p.shape[1] here)."""
+    mutate in place — see the module docstring for the carry-layout
+    contract that keeps the donated matrix XLA-write-free end to end.
+    ``delta``/``sel`` are (N,)-or-longer f32 vectors (padded with zeros
+    up to p.shape[1] here)."""
     ntot = p.shape[1]
     # floor, not ceil: P has n + BLK columns, so floor(ntot/BLK) blocks
     # always cover every real row without the last window overrunning

@@ -1,0 +1,98 @@
+"""Perf regression gate (bench.py): fires on a synthetic slow result,
+passes on a fast one, and skips silently when there is nothing
+comparable to gate against."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "bench_under_test",
+    os.path.join(os.path.dirname(__file__), "..", "bench.py"),
+)
+bench = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(bench)
+
+METRIC = "sec/iteration (binary, 1000000x28, max_bin=63, num_leaves=255)"
+
+
+def _capture(tmp_path, name, value, metric=METRIC, **parsed_extra):
+    doc = {"n": 1, "rc": 0,
+           "parsed": dict({"metric": metric, "value": value}, **parsed_extra)}
+    (tmp_path / name).write_text(json.dumps(doc))
+
+
+def test_gate_fires_on_synthetic_slow_result(tmp_path):
+    _capture(tmp_path, "BENCH_r01.json", 0.20)
+    _capture(tmp_path, "BENCH_r02.json", 0.10)  # the best prior
+    out = {"metric": METRIC, "value": 0.1366}
+    rc = bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={})
+    assert rc == 1
+    assert out["regression"] is True
+    assert out["gate"]["best_prior_s_per_iter"] == 0.10
+    assert out["gate"]["best_prior_source"] == "BENCH_r02.json"
+    assert out["gate"]["threshold_s_per_iter"] == pytest.approx(0.11)
+
+
+def test_gate_passes_within_threshold(tmp_path):
+    _capture(tmp_path, "BENCH_r01.json", 0.10)
+    out = {"metric": METRIC, "value": 0.105}  # 5% slower: within the 10% band
+    rc = bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={})
+    assert rc == 0
+    assert "regression" not in out
+    assert out["gate"]["best_prior_s_per_iter"] == 0.10
+
+
+def test_gate_passes_on_improvement(tmp_path):
+    _capture(tmp_path, "BENCH_r01.json", 0.1366)
+    out = {"metric": METRIC, "value": 0.1000}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+    assert "regression" not in out
+
+
+def test_silent_skip_without_comparable_priors(tmp_path):
+    # no files at all
+    out = {"metric": METRIC, "value": 9.9}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+    assert "gate" not in out and "regression" not in out
+    # a dead capture (parsed: null, the BENCH_r05 shape) + garbage file
+    (tmp_path / "BENCH_r05.json").write_text(
+        json.dumps({"n": 5, "rc": 1, "parsed": None}))
+    (tmp_path / "BENCH_r06.json").write_text("{torn json")
+    # and a different-metric capture (other row count: not comparable)
+    _capture(tmp_path, "BENCH_r04.json", 0.01,
+             metric="sec/iteration (binary, 120000x28, max_bin=63, num_leaves=255)")
+    out = {"metric": METRIC, "value": 9.9}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+    assert "gate" not in out and "regression" not in out
+
+
+def test_backend_fallback_runs_never_gate(tmp_path):
+    _capture(tmp_path, "BENCH_r01.json", 0.10)
+    # a fallback CPU run is not comparable to device captures
+    out = {"metric": METRIC, "value": 9.9, "backend_fallback": True}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+    assert "regression" not in out
+    # ... and fallback PRIORS are not a baseline either
+    _capture(tmp_path, "BENCH_r02.json", 0.001, backend_fallback=True)
+    out = {"metric": METRIC, "value": 0.105}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+    assert out["gate"]["best_prior_s_per_iter"] == 0.10  # r02 ignored
+
+
+def test_opt_out(tmp_path):
+    _capture(tmp_path, "BENCH_r01.json", 0.10)
+    out = {"metric": METRIC, "value": 9.9}
+    rc = bench.apply_regression_gate(out, bench_dir=str(tmp_path),
+                                     env={"BENCH_GATE": "0"})
+    assert rc == 0 and "regression" not in out and "gate" not in out
+
+
+def test_raw_bench_format_accepted(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps({"metric": METRIC, "value": 0.10, "unit": "s/iter"}))
+    out = {"metric": METRIC, "value": 0.2}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 1
+    assert out["regression"] is True

@@ -62,12 +62,16 @@ def _check_split_stream(P, lay, start, cnt, feat, thr, zb, dbz, cat, bits=8,
     outside the segment, and both returned histograms matching hist_ref
     on the reference-partitioned children."""
     per = 32 // bits
+    # reference FIRST: split_stream donates its input buffer (the jit
+    # wrapper carries donate_argnums), so P must not be read afterwards —
+    # pass a copy so callers can reuse P across checks
+    Pref, nlref = pk.partition_ref(P, start, cnt, feat, zb, dbz, thr, bool(cat), lay)
     P2, nl, lh, rh = pk.split_stream(
-        P, start, cnt, feat // per, (feat % per) * bits, zb, dbz, thr, cat,
+        jnp.array(P), start, cnt, feat // per, (feat % per) * bits, zb, dbz,
+        thr, cat,
         num_features=lay.F, num_bins=nbins, bits=bits, rows=lay.rows,
         interpret=INTERP,
     )
-    Pref, nlref = pk.partition_ref(P, start, cnt, feat, zb, dbz, thr, bool(cat), lay)
     assert int(nl) == nlref
     P2n, Prefn = np.asarray(P2), np.asarray(Pref)
     # outside the segment: bit-identical
@@ -143,8 +147,10 @@ class TestLevelStreamKernel:
         for i, (s, c, f, t, zb, dbz, cat) in enumerate(segs):
             tab[i] = [s, c, f // per, (f % per) * lay.bits, zb, dbz, t, cat,
                       0, 1 << lay.bits, 0, 0]
+        # level_stream donates its input: hand it a copy, the per-segment
+        # split_stream chain below still consumes the original P
         pl_, nl, hists = pk.level_stream(
-            P, jnp.asarray(tab), jnp.int32(len(segs)), num_features=F,
+            jnp.array(P), jnp.asarray(tab), jnp.int32(len(segs)), num_features=F,
             num_bins=B, bits=lay.bits, rows=lay.rows, smax=smax,
             interpret=INTERP,
         )
@@ -171,12 +177,13 @@ class TestLevelStreamKernel:
 
     def test_zero_active_is_noop(self):
         P, lay, *_ = _make_packed(n=3000)
+        Pn = np.asarray(P)  # snapshot: level_stream donates its input
         tab = jnp.zeros((8, 12), jnp.int32)
         pl_, nl, _ = pk.level_stream(
             P, tab, jnp.int32(0), num_features=lay.F, num_bins=32,
             bits=lay.bits, rows=lay.rows, smax=8, interpret=INTERP,
         )
-        np.testing.assert_array_equal(np.asarray(pl_), np.asarray(P))
+        np.testing.assert_array_equal(np.asarray(pl_), Pn)
 
 
 class TestTwoEndProtocol:
@@ -755,3 +762,71 @@ class TestLevelGrowerCaps:
         assert max(leaves["1"]) == 1023, leaves
         # level-batched growth is tree-identical to per-split growth
         np.testing.assert_array_equal(preds["1"], preds["0"])
+
+
+class TestScoreAddBand:
+    """score_add streams ONLY the 8-aligned mutable band (PR-6 fused
+    score-update): exact += on the target score row, every other row —
+    including the packed bin words it no longer reads — bit-identical."""
+
+    def test_band_add_exact(self):
+        n = 3000
+        P, lay, bins, g, h, sel = _make_packed(n=n)
+        rng = np.random.default_rng(21)
+        delta = rng.standard_normal(n).astype(np.float32)
+        P0 = np.asarray(P, np.int32)
+        P2 = pk.score_add(jnp.array(P), lay, jnp.asarray(delta), 0,
+                          num_rows=n, interpret=INTERP)
+        P2n = np.asarray(P2, np.int32)
+        want = P0[lay.SCORE, :n].view(np.float32) + delta
+        np.testing.assert_array_equal(
+            P2n[lay.SCORE, :n].view(np.float32), want)
+        # nothing else moved (bin words, g/h, sel, label, rowid, weight)
+        other = [r for r in range(lay.C) if r != lay.SCORE]
+        np.testing.assert_array_equal(P2n[other][:, :n], P0[other][:, :n])
+
+    def test_multiclass_channel_k(self):
+        n = 2000
+        rng = np.random.default_rng(22)
+        f, K = 6, 3
+        lay = pk.PLayout(f, num_score=K)
+        bins = rng.integers(0, 16, size=(n, f), dtype=np.uint8)
+        P = pk.pack_matrix(bins, lay, label=rng.random(n).astype(np.float32))
+        delta = rng.standard_normal(n).astype(np.float32)
+        P0 = np.asarray(P, np.int32)
+        P2 = pk.score_add(jnp.array(P), lay, jnp.asarray(delta), 1,
+                          num_rows=n, interpret=INTERP)
+        P2n = np.asarray(P2, np.int32)
+        np.testing.assert_array_equal(
+            P2n[lay.SCORE + 1, :n].view(np.float32),
+            P0[lay.SCORE + 1, :n].view(np.float32) + delta)
+        other = [r for r in range(lay.C) if r != lay.SCORE + 1]
+        np.testing.assert_array_equal(P2n[other][:, :n], P0[other][:, :n])
+
+
+class TestUpdateHistFree:
+    """update_and_root_hist(with_hist=False) — the GOSS gradient-prep /
+    settle fast path — must write the exact same matrix as the
+    histogram-carrying pass, just without the discarded histogram."""
+
+    def test_matrix_bit_identical(self):
+        n = 3000
+        P, lay, bins, g, h, sel = _make_packed(n=n)
+        rng = np.random.default_rng(23)
+        delta = rng.standard_normal(n).astype(np.float32)
+        sel_new = (rng.random(n) < 0.6).astype(np.float32)
+
+        def grad_fn(score, label, weight):
+            ps = 1.0 / (1.0 + jnp.exp(-score))
+            return (ps - label) * weight, ps * (1.0 - ps) * weight
+
+        Pa, hist = pk.update_and_root_hist(
+            jnp.array(P), lay, grad_fn, delta=delta, sel=sel_new, num_rows=n,
+            num_features=lay.F, num_bins=32, interpret=INTERP)
+        Pb, no_hist = pk.update_and_root_hist(
+            jnp.array(P), lay, grad_fn, delta=delta, sel=sel_new, num_rows=n,
+            num_features=lay.F, num_bins=32, with_hist=False, interpret=INTERP)
+        assert no_hist is None
+        assert hist is not None and np.asarray(hist).shape == (lay.F, 32, 3)
+        np.testing.assert_array_equal(np.asarray(Pa, np.int32),
+                                      np.asarray(Pb, np.int32))
