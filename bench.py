@@ -807,6 +807,17 @@ def main():
                 w["retraces"] for w in cw["watched"].values()
             ),
         }
+        # Prometheus dump next to the trace (docs/OBSERVABILITY.md): the
+        # same registry the serve front end scrapes, frozen at end of
+        # bench — every mirrored trace counter/gauge + compile totals
+        from lightgbm_tpu.obs.metrics import registry as _metrics_registry
+
+        metrics_path = tracer.path + ".metrics.txt"
+        try:
+            _metrics_registry.dump(metrics_path)
+            out["metrics_path"] = metrics_path
+        except OSError:
+            pass
 
     # device memory footprint (validates the no-scratch-copy design at
     # Higgs scale; axon may not expose memory_stats — best-effort)

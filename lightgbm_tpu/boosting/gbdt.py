@@ -39,6 +39,7 @@ class _memo:
         return self.value
 
 from ..obs import fence, tracer
+from ..obs.audit import audit
 from ..ops.grow import GrowParams, grow_tree
 from ..ops.predict import add_leaf_outputs, predict_binned, predict_raw
 from ..ops.split import FeatureMeta, SplitHyper
@@ -73,6 +74,7 @@ class GBDT:
     def init(self, config, train_set, objective, training_metrics=()):
         """GBDT::Init + ResetTrainingData (gbdt.cpp:65-218)."""
         tracer.refresh_from_env()  # LIGHTGBM_TPU_TRACE may be set per-run
+        audit.refresh_from_env()  # LIGHTGBM_TPU_AUDIT split-decision trail
         self.config = config
         self.train_set = train_set
         self.objective = objective
@@ -375,6 +377,7 @@ class GBDT:
                     leaves_grown += num_splits + 1
                     tree = Tree.from_grow_result(gr, self.train_set)
                     tree.shrinkage(self.shrinkage_rate)
+                    audit.record_tree(self.iter, k, gr, tree)
                     with timetag.phase("train_score"):
                         # score update via the grower's partition (one gather)
                         lv = np.zeros(self.grow_params.num_leaves, np.float32)
@@ -472,6 +475,7 @@ class GBDT:
                 if int(view.num_splits) > 0:
                     tree = Tree.from_grow_result(view, self.train_set)
                     tree.shrinkage(self.shrinkage_rate)
+                    audit.record_tree(self.iter + t, k, view, tree)
                     chunk_trees[k].append(tree)
                 else:
                     tree = Tree(2)  # empty tree, kept for class alignment

@@ -106,7 +106,12 @@ def run_train(config: Config, params: Dict[str, str]) -> None:
         train_ds.save_binary(config.data + ".bin")
 
     from .ckpt import CheckpointManager, PreemptionExit
+    from .obs import flight
     from .parallel.net import NetError
+
+    # live-run forensics: SIGUSR1 flushes the flight-recorder ring to
+    # <trace>.crash.jsonl without disturbing training (docs/OBSERVABILITY.md)
+    flight.install_signal_handler()
 
     b = booster.boosting
     num_iters = config.num_iterations
@@ -168,6 +173,24 @@ def run_train(config: Config, params: Dict[str, str]) -> None:
         mgr.close()
     b.save_model_to_file(config.output_model)
     Log.info("Finished training, model saved to %s", config.output_model)
+    _dump_metrics_if_requested()
+
+
+def _dump_metrics_if_requested() -> None:
+    """End-of-train Prometheus dump: LIGHTGBM_TPU_METRICS=path writes
+    the registry (compile accounting + every mirrored trace counter and
+    gauge) in the exposition text format — the offline twin of the
+    serve front end's live ``GET /metrics``."""
+    path = os.environ.get("LIGHTGBM_TPU_METRICS", "").strip()
+    if not path:
+        return
+    from .obs.metrics import registry
+
+    try:
+        registry.dump(path)
+        Log.info("Metrics dumped to %s", path)
+    except OSError as e:
+        Log.warning("Could not dump metrics to %s: %s", path, e)
 
 
 def run_ingest(config: Config, params: Dict[str, str]) -> None:
@@ -290,6 +313,12 @@ def main(argv: List[str] = None) -> int:
         )
         return _net_exit(EXIT_NET_TIMEOUT)
     except Exception as ex:  # main.cpp catches and exits non-zero
+        try:  # fatal path: leave a flight-recorder dump alongside the trace
+            from .obs import flight
+
+            flight.dump("fatal_error", error=ex)
+        except Exception:
+            pass
         Log.warning("Met Exceptions: %s", ex)
         return 1
     return 0

@@ -15,6 +15,7 @@ from .basic import Booster, Dataset
 from .ckpt.manager import PreemptionExit
 from .config import canonicalize_params
 from .obs import tracer
+from .obs.audit import audit
 from .parallel.net import NetError
 from .utils.log import Log
 
@@ -52,6 +53,7 @@ def train(
     run), ``False`` (never), or ``"force"`` (require a checkpoint).
     A resumed run is bit-identical to one that never died."""
     tracer.refresh_from_env()  # LIGHTGBM_TPU_TRACE=trace.jsonl
+    audit.refresh_from_env()   # LIGHTGBM_TPU_AUDIT=audit.jsonl
     params = dict(params or {})
     canon = canonicalize_params(params)
     num_boost_round = int(canon.pop("num_iterations", num_boost_round))

@@ -11,7 +11,11 @@ Import surface (kept tiny — hot paths touch only ``tracer``/``fence``):
 Submodules: ``trace`` (spans/counters/gauges/iteration records, JSONL
 sink), ``compilewatch`` (jax.monitoring compile counter + JitWatch
 retrace detector), ``memory`` (host/device gauges), ``report``
-(aggregation + the ``python -m lightgbm_tpu report`` CLI).
+(aggregation + the ``python -m lightgbm_tpu report`` CLI, incl. the
+cross-rank ``merge`` and audit ``diff`` subcommands), ``metrics``
+(Prometheus text-format registry behind ``GET /metrics``), ``audit``
+(LIGHTGBM_TPU_AUDIT split-decision trail), ``flight`` (crash flight
+recorder dumping to ``<trace>.crash.jsonl``).
 """
 
 from .trace import Tracer, fence, tracer  # noqa: F401

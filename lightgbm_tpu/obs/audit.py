@@ -1,0 +1,111 @@
+"""Split-decision audit trail — every accepted split, as JSONL.
+
+The reference's model-text dump records the *final* tree; when two runs
+disagree (the open LEVELGROW=1 vs =0 divergence, ROADMAP item 1) the
+model diff says "trees differ" without saying WHICH decision diverged
+first.  This stream records every accepted split in acceptance order —
+(iteration, class, split ordinal, leaf, feature, bin threshold, real
+threshold, gain, default-left, left/right counts) plus each finished
+tree's leaf values — so ``python -m lightgbm_tpu report diff a b``
+pins the first divergent decision to a single line.
+
+Enable with ``LIGHTGBM_TPU_AUDIT=path`` (re-read at every
+``engine.train`` / ``GBDT.init``, like the tracer).  Disabled mode is
+one attribute check per tree.
+
+Determinism contract: records carry NO timestamps, floats are emitted
+through Python repr (shortest round-trip — byte-identical iff the
+doubles are bit-identical), keys are written in fixed order, and the
+record order is the trainer's split-acceptance order.  Two runs that
+build bit-identical trees therefore produce byte-identical audit files;
+the parity leg of tests/test_audit.py pins exactly that, and the
+divergence leg pins that ``report diff`` localizes the first
+divergent (iteration, leaf, feature, threshold, gain) at the
+known-divergent LEVELGROW config.
+
+The fields come from the grower's raw split records via
+``ops/pgrow.split_audit_rows`` — the same records every trainer path
+(mask grower, fused classic, fused level-batched, traced) feeds into
+``Tree.from_grow_result``, which is what makes the trail comparable
+across LEVELGROW modes in the first place.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+
+class AuditWriter:
+    """Process-global JSONL audit sink (LIGHTGBM_TPU_AUDIT=path)."""
+
+    def __init__(self):
+        self.enabled = False
+        self.path: Optional[str] = None
+        self._f = None
+
+    def refresh_from_env(self) -> None:
+        path = os.environ.get("LIGHTGBM_TPU_AUDIT", "")
+        if path and path != self.path:
+            self.configure(path)
+
+    def configure(self, path: str) -> None:
+        self.close()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(path, "w", buffering=1)  # line buffered
+        self.path = path
+        self.enabled = True
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.flush()
+                self._f.close()
+            except Exception:  # pragma: no cover - interpreter teardown
+                pass
+        self._f = None
+        self.enabled = False
+
+    def _write(self, rec: Dict[str, Any]) -> None:
+        if self._f is not None:
+            self._f.write(json.dumps(rec) + "\n")
+
+    def record_tree(self, it: int, k: int, view, tree) -> None:
+        """Emit the accepted splits of one finished tree plus its leaf
+        values.  ``view`` is the GrowResult-like raw-record view
+        (``ops/grow.GrowResult`` or ``ptrainer.grow_result_view``);
+        ``tree`` is the built ``model.tree.Tree`` AFTER shrinkage, so
+        the recorded thresholds/values are exactly the model's."""
+        if not self.enabled:
+            return
+        from ..ops.pgrow import split_audit_rows
+
+        for row in split_audit_rows(view):
+            s = row["s"]
+            rec = {
+                "ev": "split", "it": int(it), "k": int(k), "s": s,
+                "leaf": row["leaf"], "feat": int(tree.split_feature[s]),
+                "bin": row["bin"],
+                "thr": float(tree.threshold[s]),
+                "gain": row["gain"],
+                # default-left: where the zero/missing bin routes under
+                # this node's decision type (tree.h decision funs)
+                "dl": int(row["dbz"] == row["bin"]
+                          if tree.decision_type[s] == 1
+                          else row["dbz"] <= row["bin"]),
+                "dbz": row["dbz"],
+                "lcnt": row["lcnt"], "rcnt": row["rcnt"],
+            }
+            self._write(rec)
+        self._write({
+            "ev": "tree", "it": int(it), "k": int(k),
+            "leaves": int(tree.num_leaves),
+            "values": [float(v) for v in
+                       tree.leaf_value[: tree.num_leaves]],
+        })
+
+
+audit = AuditWriter()

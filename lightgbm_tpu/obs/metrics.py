@@ -1,0 +1,395 @@
+"""Dependency-free Prometheus text-format metrics registry.
+
+The PR-2 serving layer kept its operational counters in per-batcher
+dicts behind a poll-once ``/stats`` JSON — fine for a human, useless for
+a fleet: Prometheus cannot scrape it, counters reset per batcher, and
+nothing exposes histograms.  This module is the scrape surface:
+
+- :class:`MetricsRegistry` holds **counters** (monotone), **gauges**
+  (sampled) and **fixed-bucket histograms** (cumulative ``le`` buckets,
+  ``_sum``/``_count``), all thread-safe and allocation-light enough to
+  sit on the serving request path.
+- Metrics may be **fn-backed**: the value is read at render time from a
+  callback (uptime, readiness, compile accounting) so scraping costs
+  nothing between scrapes and NEVER touches jax — a ``GET /metrics``
+  can not trigger an XLA compile (pinned by tests/test_metrics.py).
+- ``render()`` emits the Prometheus exposition text format
+  (``# HELP`` / ``# TYPE`` lines, cumulative histogram buckets ending
+  at ``le="+Inf"``), served by ``GET /metrics`` on the serve front end
+  and dumpable at end-of-train via ``LIGHTGBM_TPU_METRICS=path``.
+- The run tracer (obs/trace.py) mirrors every enabled-mode
+  ``tracer.counter``/``tracer.gauge`` here under the mechanical mapping
+  ``name.with.dots`` -> ``lightgbm_tpu_name_with_dots[_total]``, so a
+  training run's net/ckpt/ingest counters land in the same dump without
+  a second instrumentation pass.  With tracing off the mirror is never
+  called (the tracer entry points return before reaching it).
+
+Every metric name in this module is part of the observability interface
+and must appear in the docs/OBSERVABILITY.md name registry — a tier-1
+lint test walks the source and fails on undocumented names.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+PREFIX = "lightgbm_tpu_"
+
+_NAME_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize(name: str) -> str:
+    """Tracer-name -> Prometheus-name fragment (dots become underscores,
+    anything else illegal collapses to '_')."""
+    return _SANITIZE.sub("_", name)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value: integers render without the trailing
+    '.0' (counters are usually whole), floats via repr (full
+    round-trip precision)."""
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class Counter:
+    """Monotone counter.  ``fn``-backed counters read their value at
+    render time (the underlying source must itself be monotone)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = "",
+                 fn: Optional[Callable[[], float]] = None):
+        self.name = name
+        self.help = help
+        self.fn = fn
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, value: float = 1.0) -> None:
+        if value < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        with self._lock:
+            self._value += value
+
+    def value(self) -> float:
+        if self.fn is not None:
+            try:
+                return float(self.fn())
+            except Exception:
+                return 0.0
+        return self._value
+
+    def samples(self) -> List[str]:
+        return [f"{self.name} {_fmt(self.value())}"]
+
+
+class Gauge:
+    """Sampled value; ``fn``-backed gauges evaluate at render time."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = "",
+                 fn: Optional[Callable[[], float]] = None):
+        self.name = name
+        self.help = help
+        self.fn = fn
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, value: float = 1.0) -> None:
+        with self._lock:
+            self._value += value
+
+    def value(self) -> float:
+        if self.fn is not None:
+            try:
+                return float(self.fn())
+            except Exception:
+                return 0.0
+        return self._value
+
+    def samples(self) -> List[str]:
+        return [f"{self.name} {_fmt(self.value())}"]
+
+
+# default latency ladder (seconds): sub-ms serving hits through
+# multi-second stragglers
+LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                   0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+# power-of-two row ladder matching the serving bucket ladder
+BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                 512.0, 1024.0, 2048.0, 4096.0)
+
+
+class Histogram:
+    """Fixed-bucket histogram: per-bucket counts are kept exclusive and
+    rendered cumulative with a final ``le="+Inf"`` bucket, plus
+    ``_sum`` and ``_count`` series (the Prometheus contract
+    ``bucket[+Inf] == count``)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Sequence[float] = LATENCY_BUCKETS):
+        self.name = name
+        self.help = help
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError(f"histogram {name} needs at least one bucket")
+        self._counts = [0] * (len(self.buckets) + 1)  # last = overflow
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = len(self.buckets)
+        for j, b in enumerate(self.buckets):
+            if v <= b:
+                i = j
+                break
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    def value(self) -> float:  # symmetry with counter/gauge (snapshot())
+        return float(self._count)
+
+    def samples(self) -> List[str]:
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+            s = self._sum
+        out = []
+        acc = 0
+        for b, c in zip(self.buckets, counts):
+            acc += c
+            out.append(f'{self.name}_bucket{{le="{b:g}"}} {acc}')
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
+        out.append(f"{self.name}_sum {_fmt(s)}")
+        out.append(f"{self.name}_count {total}")
+        return out
+
+
+class MetricsRegistry:
+    """Process-global named-metric store.  ``counter``/``gauge``/
+    ``histogram`` are get-or-create (idempotent by name); re-registering
+    an fn-backed metric replaces the callback (tests and the serve
+    layer construct servers repeatedly in one process — latest wins)."""
+
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, cls, name: str, help: str, **kw):
+        if not _NAME_OK.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, **kw)
+                self._metrics[name] = m
+                return m
+            if not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name} already registered as {m.kind}"
+                )
+            if kw.get("fn") is not None:
+                m.fn = kw["fn"]
+            if help and not m.help:
+                m.help = help
+            return m
+
+    def counter(self, name: str, help: str = "",
+                fn: Optional[Callable[[], float]] = None) -> Counter:
+        return self._get_or_create(Counter, name, help, fn=fn)
+
+    def gauge(self, name: str, help: str = "",
+              fn: Optional[Callable[[], float]] = None) -> Gauge:
+        return self._get_or_create(Gauge, name, help, fn=fn)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = LATENCY_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    # -- tracer mirror -------------------------------------------------
+    def _mirror_target(self, n: str):
+        """Get-or-create the mirror metric ``n`` unless that name is
+        already EXPLICITLY instrumented at the source (the serve layer
+        both updates its registry metrics directly and traces the same
+        signal — mirroring would double count).  Mirror-created metrics
+        are tagged so repeat mirrors keep flowing to them."""
+        with self._lock:
+            m = self._metrics.get(n)
+        if m is not None and not getattr(m, "mirrored", False):
+            return None
+        return m
+
+    def trace_counter(self, name: str, value: float) -> None:
+        """Mirror of an enabled-mode ``tracer.counter``: dotted trace
+        names land as ``lightgbm_tpu_<sanitized>_total``."""
+        n = PREFIX + sanitize(name)
+        if not n.endswith("_total"):
+            n += "_total"
+        m = self._mirror_target(n)
+        if m is None:
+            with self._lock:
+                if n in self._metrics:
+                    return
+            m = self.counter(n, help=f"mirror of trace counter {name}")
+            m.mirrored = True
+        m.inc(value)
+
+    def trace_gauge(self, name: str, value: float) -> None:
+        n = PREFIX + sanitize(name)
+        m = self._mirror_target(n)
+        if m is None:
+            with self._lock:
+                if n in self._metrics:
+                    return
+            m = self.gauge(n, help=f"mirror of trace gauge {name}")
+            m.mirrored = True
+        m.set(value)
+
+    # -- output --------------------------------------------------------
+    def render(self) -> str:
+        """Prometheus exposition text format (content type
+        ``text/plain; version=0.0.4``).  Never imports or touches jax:
+        fn-backed metrics must read plain host state only."""
+        lines: List[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in sorted(metrics, key=lambda m: m.name):
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            lines.extend(m.samples())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def snapshot(self) -> Dict[str, float]:
+        """{name: scalar value} view (histograms report their count) —
+        what bench.py embeds and tests assert against."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return {m.name: m.value() for m in metrics}
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.render())
+
+    def _reset_for_tests(self) -> None:
+        """Zero every stored value IN PLACE — modules hold references to
+        their metric objects, so clearing the dict would orphan them.
+        fn-backed metrics read external monotone state and are left
+        alone (tests compare deltas on those)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            if isinstance(m, Histogram):
+                with m._lock:
+                    m._counts = [0] * (len(m.buckets) + 1)
+                    m._sum = 0.0
+                    m._count = 0
+            elif m.fn is None:
+                with m._lock:
+                    m._value = 0.0
+
+
+registry = MetricsRegistry()
+
+
+def _compile_stat(key: str) -> Callable[[], float]:
+    def read() -> float:
+        from . import compilewatch
+
+        return float(compilewatch.snapshot()[key])
+
+    return read
+
+
+def _watched_stat(watch: str, key: str) -> Callable[[], float]:
+    def read() -> float:
+        from . import compilewatch
+
+        return float(compilewatch.snapshot()["watched"].get(watch, {})
+                     .get(key, 0))
+
+    return read
+
+
+def _install_default_collectors(reg: MetricsRegistry) -> None:
+    """Compile accounting is useful in every process (train or serve),
+    costs nothing until rendered, and reads plain counters — register
+    the fn-backed metrics once at import."""
+    reg.counter("lightgbm_tpu_xla_compiles_total",
+                "XLA backend compilations observed by obs/compilewatch",
+                fn=_compile_stat("backend_compiles"))
+    reg.counter("lightgbm_tpu_xla_compile_seconds_total",
+                "cumulative XLA backend compile seconds",
+                fn=_compile_stat("backend_compile_secs"))
+    reg.counter("lightgbm_tpu_serve_predict_compiles_total",
+                "compiles of the watched serve.predict_raw entry point",
+                fn=_watched_stat("serve.predict_raw", "compiles"))
+    reg.counter("lightgbm_tpu_serve_predict_retraces_total",
+                "unexpected retraces flagged on serve.predict_raw",
+                fn=_watched_stat("serve.predict_raw", "retraces"))
+
+
+_install_default_collectors(registry)
+
+
+def parse_text_format(text: str) -> Dict[str, Dict]:
+    """Minimal exposition-format parser for tests and the report CLI:
+    returns {metric_family: {"type": ..., "samples": {sample_key: value}}}
+    where sample_key includes any label suffix (e.g. 'name_bucket{le="1"}').
+    Raises ValueError on malformed lines — the format-validity test
+    feeds every scrape through this."""
+    out: Dict[str, Dict] = {}
+    current: Optional[str] = None
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(None, 3)
+            if len(parts) != 4 or parts[3] not in ("counter", "gauge",
+                                                   "histogram", "summary",
+                                                   "untyped"):
+                raise ValueError(f"line {ln}: malformed TYPE line {line!r}")
+            current = parts[2]
+            out[current] = {"type": parts[3], "samples": {}}
+            continue
+        if line.startswith("#"):
+            raise ValueError(f"line {ln}: unknown comment {line!r}")
+        try:
+            key, val = line.rsplit(None, 1)
+            fval = float(val)
+        except ValueError:
+            raise ValueError(f"line {ln}: malformed sample {line!r}")
+        base = key.split("{")[0]
+        fam = None
+        for suffix in ("_bucket", "_sum", "_count", ""):
+            cand = base[: len(base) - len(suffix)] if suffix else base
+            if suffix and not base.endswith(suffix):
+                continue
+            if cand in out:
+                fam = cand
+                break
+        if fam is None:
+            raise ValueError(f"line {ln}: sample {key!r} precedes its TYPE line")
+        if not _NAME_OK.match(base):
+            raise ValueError(f"line {ln}: invalid sample name {base!r}")
+        out[fam]["samples"][key] = fval
+    return out

@@ -1,32 +1,69 @@
-"""Trace-file summarizer — ``python -m lightgbm_tpu report trace.jsonl``.
+"""Trace-file reporting — ``python -m lightgbm_tpu report ...``.
 
-Renders a TIMETAG-style table (the reference's destructor dump,
-serial_tree_learner.cpp:12-24, but from structured records instead of
-printf): per-phase totals over the run, per-iteration statistics,
-compile/retrace accounting and memory watermarks.  ``summarize`` is
-also importable — bench.py uses it to fold a (possibly partial) trace of
-a dead run into its failure report.
+Subcommands:
+
+  report <trace.jsonl> [--json]   TIMETAG-style single-trace summary
+                                  (per-phase totals, per-iteration
+                                  stats, compile/retrace accounting,
+                                  memory watermarks)
+  report merge <dir|files...>     cross-rank aggregation: aligns the
+                                  per-rank JSONLs of one multi-host run
+                                  on iteration boundaries and emits a
+                                  per-phase per-rank timeline with
+                                  straggler attribution (slowest-rank
+                                  share, barrier-wait vs compute from
+                                  the net.* spans)
+  report diff <a.jsonl> <b.jsonl> first divergent record between two
+                                  JSONL streams — built for the
+                                  LIGHTGBM_TPU_AUDIT split-decision
+                                  trail, where it pins the first
+                                  divergent (iteration, leaf, feature,
+                                  threshold, gain); exit 1 on
+                                  divergence like diff(1)
+
+``summarize`` is also importable — bench.py uses it to fold a (possibly
+partial) trace of a dead run into its failure report.  All loaders
+tolerate torn/garbage lines (crash-cut traces) by skipping them with a
+warning instead of raising.
 """
 
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List
+import sys
+from typing import Any, Dict, List, Optional
 
 
-def load_trace(path: str) -> List[Dict[str, Any]]:
-    """Read a JSONL trace, tolerating a torn final line (the run died
-    mid-write) — partial traces are the point."""
+def load_trace(path: str, warn: bool = True) -> List[Dict[str, Any]]:
+    """Read a JSONL trace, tolerating torn or garbage lines (the run
+    died mid-write, or a crash truncated the tail) — partial traces are
+    the point.  Skipped lines warn to stderr instead of raising."""
     records = []
+    skipped = 0
     with open(path) as f:
-        for line in f:
+        for ln, line in enumerate(f, 1):
             line = line.strip()
             if not line:
                 continue
             try:
-                records.append(json.loads(line))
+                rec = json.loads(line)
             except ValueError:
-                continue  # torn tail record from a killed process
+                skipped += 1
+                if warn:
+                    sys.stderr.write(
+                        f"warning: {path}:{ln}: skipping unparsable "
+                        f"record (torn tail from a killed run?)\n"
+                    )
+                continue
+            if not isinstance(rec, dict):
+                skipped += 1
+                if warn:
+                    sys.stderr.write(
+                        f"warning: {path}:{ln}: skipping non-object "
+                        f"record\n"
+                    )
+                continue
+            records.append(rec)
     return records
 
 
@@ -169,15 +206,327 @@ def render(summary: Dict[str, Any], path: str = "") -> str:
     return "\n".join(lines) + "\n"
 
 
-def main(argv: List[str]) -> int:
-    """CLI entry: ``python -m lightgbm_tpu report <trace.jsonl> [--json]``."""
-    import sys
+# ----------------------------------------------------------------------
+# cross-rank merge (report merge <dir|files...>)
+# ----------------------------------------------------------------------
+def _rank_of(records: List[Dict[str, Any]], fallback: int) -> int:
+    for r in records:
+        if "rank" in r:
+            return int(r["rank"])
+    return fallback
+
+
+def load_rank_traces(paths: List[str]) -> Dict[int, List[Dict[str, Any]]]:
+    """Load per-rank trace files into {rank: records}.  Rank comes from
+    the records themselves (the tracer stamps ``rank`` in multi-rank
+    runs); files without a rank field fall back to their argument
+    order, with a warning."""
+    by_rank: Dict[int, List[Dict[str, Any]]] = {}
+    for i, p in enumerate(sorted(paths)):
+        recs = load_trace(p)
+        rank = _rank_of(recs, fallback=i)
+        if not any("rank" in r for r in recs):
+            sys.stderr.write(
+                f"warning: {p}: records carry no rank field; assuming "
+                f"rank {rank} from argument order\n"
+            )
+        if rank in by_rank:
+            sys.stderr.write(
+                f"warning: {p}: duplicate rank {rank}; concatenating\n"
+            )
+            by_rank[rank].extend(recs)
+        else:
+            by_rank[rank] = recs
+    return by_rank
+
+
+def _iter_wait_s(phases: Dict[str, float]) -> float:
+    """Barrier-wait attributed inside one iteration record.  net.barrier
+    spans nest a net.allgather span and BOTH accumulate into the phases
+    map, so take the max of the pair rather than their sum."""
+    return max(float(phases.get("net.barrier", 0.0)),
+               float(phases.get("net.allgather", 0.0)))
+
+
+def _rank_net_wait_s(records: List[Dict[str, Any]]) -> float:
+    """Total barrier/collective wait from this rank's span records:
+    top-level net.barrier spans plus net.allgather spans that are NOT
+    nested inside a barrier (double-count guard via the parent field)."""
+    total = 0.0
+    for r in records:
+        if r.get("ev") != "span":
+            continue
+        name = r.get("name", "")
+        if name == "net.barrier":
+            total += float(r.get("dur_s", 0.0))
+        elif name == "net.allgather" and r.get("parent") != "net.barrier":
+            total += float(r.get("dur_s", 0.0))
+    return total
+
+
+def merge_summary(by_rank: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
+    """Cross-rank aggregation aligned on iteration boundaries.
+
+    Per rank and per common iteration (present on EVERY rank — torn
+    tails shrink the aligned window rather than skewing it):
+    ``wall_s`` and its split into ``wait_s`` (the net.barrier /
+    net.allgather share of the iteration) and ``compute_s`` (the rest).
+    The straggler is the rank with the largest aligned compute total;
+    ``slowest_rank_share`` is its share of fleet compute, and
+    ``wait_behind_straggler_s`` is what every other rank spent parked
+    in barriers — the time a rebalance could reclaim (ROADMAP item 3).
+    """
+    ranks = sorted(by_rank)
+    run_ids = {r.get("run_id") for recs in by_rank.values()
+               for r in recs if r.get("run_id") is not None}
+    worlds = {int(r["world"]) for recs in by_rank.values()
+              for r in recs if "world" in r}
+    if len(run_ids) > 1:
+        sys.stderr.write(
+            f"warning: traces carry {len(run_ids)} distinct run_ids "
+            f"{sorted(map(str, run_ids))} — are these files from one run?\n"
+        )
+    iters: Dict[int, Dict[int, Dict[str, float]]] = {}  # rank -> it -> rec
+    phases: Dict[str, Dict[int, float]] = {}            # phase -> rank -> s
+    for rank in ranks:
+        per_it: Dict[int, Dict[str, float]] = {}
+        for r in by_rank[rank]:
+            if r.get("ev") != "iter":
+                continue
+            it = int(r.get("iter", -1))
+            ph = r.get("phases") or {}
+            wall = float(r.get("wall_s", 0.0))
+            wait = min(_iter_wait_s(ph), wall)
+            per_it[it] = {"wall_s": wall, "wait_s": wait,
+                          "compute_s": wall - wait}
+            for name, dur in ph.items():
+                phases.setdefault(name, {})
+                phases[name][rank] = phases[name].get(rank, 0.0) + float(dur)
+        iters[rank] = per_it
+    common = sorted(set.intersection(*(set(iters[r]) for r in ranks))
+                    if ranks else set())
+    timeline = []
+    for it in common:
+        walls = {r: iters[r][it]["wall_s"] for r in ranks}
+        computes = {r: iters[r][it]["compute_s"] for r in ranks}
+        slowest = max(ranks, key=lambda r: computes[r])
+        timeline.append({
+            "iter": it,
+            "wall_s": {r: round(walls[r], 6) for r in ranks},
+            "compute_s": {r: round(computes[r], 6) for r in ranks},
+            "wait_s": {r: round(iters[r][it]["wait_s"], 6) for r in ranks},
+            "slowest_rank": slowest,
+        })
+    per_rank = {}
+    for rank in ranks:
+        wall = sum(iters[rank][it]["wall_s"] for it in common)
+        wait = sum(iters[rank][it]["wait_s"] for it in common)
+        per_rank[rank] = {
+            "iterations": len(iters[rank]),
+            "aligned_iterations": len(common),
+            "wall_s": round(wall, 6),
+            "compute_s": round(wall - wait, 6),
+            "barrier_wait_s": round(wait, 6),
+            "net_wait_total_s": round(_rank_net_wait_s(by_rank[rank]), 6),
+        }
+    out: Dict[str, Any] = {
+        "ranks": ranks,
+        "world_size": (sorted(worlds)[-1] if worlds else len(ranks)),
+        "run_id": (sorted(map(str, run_ids))[0] if len(run_ids) == 1
+                   else None),
+        "aligned_iterations": len(common),
+        "per_rank": per_rank,
+        "phases": {
+            name: {r: round(v, 6) for r, v in sorted(vals.items())}
+            for name, vals in sorted(
+                phases.items(),
+                key=lambda kv: -sum(kv[1].values()))
+        },
+        "timeline": timeline,
+    }
+    if ranks and common:
+        compute = {r: per_rank[r]["compute_s"] for r in ranks}
+        total_compute = sum(compute.values())
+        straggler = max(ranks, key=lambda r: compute[r])
+        slowest_counts = [t["slowest_rank"] for t in timeline]
+        out["straggler"] = {
+            "rank": straggler,
+            "slowest_rank_share": round(
+                compute[straggler] / total_compute, 4
+            ) if total_compute > 0 else None,
+            "slowest_in_iters": slowest_counts.count(straggler),
+            "wait_behind_straggler_s": round(
+                sum(per_rank[r]["barrier_wait_s"]
+                    for r in ranks if r != straggler), 6),
+        }
+    return out
+
+
+def render_merge(m: Dict[str, Any]) -> str:
+    lines = []
+    rid = f" run_id={m['run_id']}" if m.get("run_id") else ""
+    lines.append(
+        f"=== lightgbm_tpu cross-rank report: {len(m['ranks'])} rank(s), "
+        f"world={m['world_size']}, {m['aligned_iterations']} aligned "
+        f"iteration(s){rid} ===")
+    ranks = m["ranks"]
+    lines.append("")
+    lines.append(f"{'rank':<8}{'iters':>7}{'wall_s':>10}{'compute_s':>11}"
+                 f"{'barrier_wait_s':>16}")
+    for r in ranks:
+        pr = m["per_rank"][r]
+        lines.append(f"{r:<8}{pr['aligned_iterations']:>7}"
+                     f"{pr['wall_s']:>10.3f}{pr['compute_s']:>11.3f}"
+                     f"{pr['barrier_wait_s']:>16.3f}")
+    st = m.get("straggler")
+    if st:
+        share = st["slowest_rank_share"]
+        share_txt = f"{100.0 * share:.1f}% of fleet compute" \
+            if share is not None else "n/a"
+        lines.append("")
+        lines.append(
+            f"straggler: rank {st['rank']} — {share_txt}, slowest in "
+            f"{st['slowest_in_iters']}/{m['aligned_iterations']} "
+            f"iteration(s); other ranks spent "
+            f"{st['wait_behind_straggler_s']:.3f} s in barrier wait")
+    if m["phases"]:
+        lines.append("")
+        header = f"{'phase':<24}" + "".join(f"rank{r:>2}/s{'':>3}"
+                                            for r in ranks)
+        lines.append(header)
+        for name, vals in m["phases"].items():
+            row = f"{name:<24}" + "".join(
+                f"{vals.get(r, 0.0):>10.3f}" for r in ranks)
+            lines.append(row)
+    return "\n".join(lines) + "\n"
+
+
+def merge_main(argv: List[str]) -> int:
+    import glob
+    import os
 
     args = [a for a in argv if not a.startswith("--")]
     as_json = "--json" in argv
     if not args:
         sys.stderr.write(
-            "usage: python -m lightgbm_tpu report <trace.jsonl> [--json]\n"
+            "usage: python -m lightgbm_tpu report merge <dir|trace.jsonl...>"
+            " [--json]\n")
+        return 2
+    paths: List[str] = []
+    for a in args:
+        if os.path.isdir(a):
+            paths.extend(p for p in glob.glob(os.path.join(a, "*.jsonl"))
+                         if not p.endswith(".crash.jsonl"))
+        else:
+            paths.append(a)
+    if not paths:
+        sys.stderr.write(f"no trace files found under {args}\n")
+        return 1
+    try:
+        by_rank = load_rank_traces(paths)
+    except OSError as e:
+        sys.stderr.write(f"cannot read traces: {e}\n")
+        return 1
+    m = merge_summary(by_rank)
+    if as_json:
+        sys.stdout.write(json.dumps(m) + "\n")
+    else:
+        sys.stdout.write(render_merge(m))
+    return 0
+
+
+# ----------------------------------------------------------------------
+# stream diff (report diff a.jsonl b.jsonl) — audit-trail divergence
+# ----------------------------------------------------------------------
+def first_divergence(
+    a: List[Dict[str, Any]], b: List[Dict[str, Any]]
+) -> Optional[Dict[str, Any]]:
+    """First record index where the two streams differ, with the
+    differing fields; None when identical.  A shorter stream diverges
+    at its end (record=None on the truncated side)."""
+    for i in range(max(len(a), len(b))):
+        ra = a[i] if i < len(a) else None
+        rb = b[i] if i < len(b) else None
+        if ra == rb:
+            continue
+        fields = []
+        if ra is not None and rb is not None:
+            for k in sorted(set(ra) | set(rb)):
+                if ra.get(k) != rb.get(k):
+                    fields.append(k)
+        return {"index": i, "a": ra, "b": rb, "fields": fields}
+    return None
+
+
+def render_divergence(div: Dict[str, Any], pa: str, pb: str) -> str:
+    a, b = div["a"], div["b"]
+    lines = [f"streams diverge at record {div['index']}:"]
+    if a is None or b is None:
+        short, path = ("a", pa) if a is None else ("b", pb)
+        lines.append(f"  {short} ({path}) ends early; the other stream "
+                     f"continues with: {json.dumps(b if a is None else a)}")
+        return "\n".join(lines) + "\n"
+    ctx = {k: a[k] for k in ("ev", "it", "k", "s", "leaf") if k in a}
+    if ctx:
+        lines.append("  at " + " ".join(f"{k}={v}" for k, v in ctx.items()))
+    for k in div["fields"]:
+        va, vb = a.get(k), b.get(k)
+        if (isinstance(va, list) and isinstance(vb, list)
+                and len(va) == len(vb)):
+            # per-leaf value arrays: name the first differing index
+            # instead of dumping two full vectors
+            for i, (xa, xb) in enumerate(zip(va, vb)):
+                if xa != xb:
+                    lines.append(f"  {k}[{i}]: a={json.dumps(xa)}  "
+                                 f"b={json.dumps(xb)}")
+            continue
+        lines.append(f"  {k}: a={json.dumps(va)}  b={json.dumps(vb)}")
+    return "\n".join(lines) + "\n"
+
+
+def diff_main(argv: List[str]) -> int:
+    args = [a for a in argv if not a.startswith("--")]
+    as_json = "--json" in argv
+    if len(args) != 2:
+        sys.stderr.write(
+            "usage: python -m lightgbm_tpu report diff <a.jsonl> <b.jsonl>"
+            " [--json]\n")
+        return 2
+    pa, pb = args
+    try:
+        a = load_trace(pa)
+        b = load_trace(pb)
+    except OSError as e:
+        sys.stderr.write(f"cannot read stream: {e}\n")
+        return 2
+    div = first_divergence(a, b)
+    if div is None:
+        sys.stdout.write(
+            json.dumps({"identical": True, "records": len(a)}) + "\n"
+            if as_json else
+            f"streams identical ({len(a)} records)\n")
+        return 0
+    if as_json:
+        sys.stdout.write(json.dumps({"identical": False, **div}) + "\n")
+    else:
+        sys.stdout.write(render_divergence(div, pa, pb))
+    return 1
+
+
+def main(argv: List[str]) -> int:
+    """CLI entry: ``python -m lightgbm_tpu report
+    {<trace.jsonl> | merge <dir|files...> | diff <a> <b>} [--json]``."""
+    if argv and argv[0] == "merge":
+        return merge_main(argv[1:])
+    if argv and argv[0] == "diff":
+        return diff_main(argv[1:])
+    args = [a for a in argv if not a.startswith("--")]
+    as_json = "--json" in argv
+    if not args:
+        sys.stderr.write(
+            "usage: python -m lightgbm_tpu report "
+            "{<trace.jsonl> | merge <dir|files...> | diff <a> <b>} "
+            "[--json]\n"
         )
         return 2
     path = args[0]

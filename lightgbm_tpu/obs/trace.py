@@ -55,6 +55,20 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 
+def _flight_recorder():
+    """Lazy accessor for the crash flight recorder (obs/flight.py) —
+    imported on first enabled-mode emit, cached after."""
+    global _FLIGHT
+    if _FLIGHT is None:
+        from . import flight
+
+        _FLIGHT = flight.recorder
+    return _FLIGHT
+
+
+_FLIGHT = None
+
+
 class _Span:
     __slots__ = ("_tr", "name", "attrs", "_t0")
 
@@ -109,6 +123,15 @@ class Tracer:
         self._iter_compiles0 = 0
         self._atexit_registered = False
         self._phases_env = None  # cached LIGHTGBM_TPU_TRACE_PHASES
+        # rank/world/run_id stamped onto every record in multi-rank runs
+        # so `report merge` can correlate per-rank JSONLs (empty in
+        # single-process runs: records stay byte-compatible with PR 1)
+        self._ident: Dict[str, Any] = {}
+        # tracer-side work counter: every record actually processed
+        # (emitted/mirrored) increments it.  The disabled-overhead guard
+        # test pins "near-zero when off" on this staying 0 — a counter
+        # of work done, not a wall-clock estimate.
+        self.work_ops = 0
 
     # -- lifecycle -----------------------------------------------------
     def refresh_from_env(self) -> None:
@@ -116,9 +139,35 @@ class Tracer:
         at the training entry points so tests and the CLI can toggle
         tracing without importing this module early."""
         self._phases_env = os.environ.get("LIGHTGBM_TPU_TRACE_PHASES", "")
+        self._ident_from_env()
         path = os.environ.get("LIGHTGBM_TPU_TRACE", "")
         if path and path != self.path:
             self.configure(path)
+
+    def _ident_from_env(self) -> None:
+        """Pre-bootstrap identity from the launcher env (the distributed
+        runtime refines it via ``set_identity`` once initialized)."""
+        rank = os.environ.get("LIGHTGBM_TPU_PROCESS_ID", "").strip()
+        world = os.environ.get("LIGHTGBM_TPU_NUM_PROCESSES", "").strip()
+        if rank and world:
+            self.set_identity(rank=int(rank), world_size=int(world))
+
+    def set_identity(self, rank: Optional[int] = None,
+                     world_size: Optional[int] = None,
+                     run_id: Optional[str] = None) -> None:
+        """Stamp rank/world_size/run_id onto every subsequent record.
+        ``run_id`` defaults to LIGHTGBM_TPU_RUN_ID, else the coordinator
+        address — both identical across ranks of one run, which is what
+        ``report merge`` verifies before correlating files."""
+        if rank is not None:
+            self._ident["rank"] = int(rank)
+        if world_size is not None:
+            self._ident["world"] = int(world_size)
+        if run_id is None:
+            run_id = (os.environ.get("LIGHTGBM_TPU_RUN_ID", "").strip()
+                      or os.environ.get("LIGHTGBM_TPU_COORDINATOR", "").strip())
+        if run_id:
+            self._ident["run_id"] = str(run_id)
 
     def configure(self, path: str) -> None:
         """Open (truncate) the JSONL sink at ``path`` and enable tracing."""
@@ -129,9 +178,14 @@ class Tracer:
             os.makedirs(d, exist_ok=True)
         self._f = open(path, "w", buffering=1)  # line buffered
         self.enabled = True
-        from . import compilewatch
+        from . import compilewatch, flight
 
         compilewatch.install()
+        # crash flight recorder: bounded ring of recent records, flushed
+        # to <trace>.crash.jsonl by typed net failures / SIGUSR1
+        # (obs/flight.py).  Activated ONLY here — tracing off means no
+        # ring is ever allocated (the disabled-overhead guard).
+        flight.recorder.activate(path)
         self._emit({
             "ev": "meta",
             "version": 1,
@@ -147,6 +201,12 @@ class Tracer:
             try:
                 self._f.flush()
                 self._f.close()
+            except Exception:  # pragma: no cover - interpreter teardown
+                pass
+            try:
+                from . import flight
+
+                flight.recorder.deactivate()
             except Exception:  # pragma: no cover - interpreter teardown
                 pass
         self._f = None
@@ -168,8 +228,13 @@ class Tracer:
 
     # -- emission ------------------------------------------------------
     def _emit(self, rec: Dict[str, Any]) -> None:
+        if self._ident:
+            for k, v in self._ident.items():
+                rec.setdefault(k, v)
         rec.setdefault("ts", round(time.time(), 6))
         line = json.dumps(rec, default=str)
+        self.work_ops += 1
+        _flight_recorder().record(rec)
         with self._lock:
             if self._f is not None:
                 self._f.write(line + "\n")
@@ -188,6 +253,9 @@ class Tracer:
         rec = {"ev": "counter", "name": name, "value": value}
         rec.update(attrs)
         self._emit(rec)
+        from . import metrics
+
+        metrics.registry.trace_counter(name, value)
 
     def gauge(self, name: str, value: float, **attrs) -> None:
         if not self.enabled:
@@ -195,6 +263,9 @@ class Tracer:
         rec = {"ev": "gauge", "name": name, "value": value}
         rec.update(attrs)
         self._emit(rec)
+        from . import metrics
+
+        metrics.registry.trace_gauge(name, value)
 
     def event(self, name: str, **attrs) -> None:
         if not self.enabled:

@@ -587,6 +587,42 @@ def segment_values(tree: PTreeResult, num_rows: int, values: jnp.ndarray) -> jnp
     return jnp.cumsum(line)[:num_rows]
 
 
+def split_audit_rows(gr):
+    """Host-side iterator over a GrowResult-like view's accepted splits,
+    in acceptance order — the audit-trail hook (obs/audit.py).
+
+    Accepts anything carrying the raw split-record contract that
+    ``Tree.from_grow_result`` consumes (``ops/grow.GrowResult``, this
+    module's :class:`PTreeResult`, ``ptrainer.grow_result_view``), which
+    is exactly why audit trails are comparable across the mask, fused
+    classic (LEVELGROW=0), level-batched (LEVELGROW=1) and traced
+    trainer paths: they all converge on these records.  Values are
+    pulled once per tree (one host transfer for device-resident views)
+    and floats keep their stored f32 identity so two bit-identical
+    record buffers yield identical rows."""
+    import numpy as np
+
+    ns = int(gr.num_splits)
+    if ns <= 0:
+        return
+    leaf = np.asarray(gr.rec_leaf)
+    thr = np.asarray(gr.rec_thr)
+    dbz = np.asarray(gr.rec_dbz)
+    gain = np.asarray(gr.rec_gain)
+    lcnt = np.asarray(gr.rec_lcnt)
+    rcnt = np.asarray(gr.rec_rcnt)
+    for s in range(ns):
+        yield {
+            "s": s,
+            "leaf": int(leaf[s]),
+            "bin": int(thr[s]),
+            "dbz": int(dbz[s]),
+            "gain": float(gain[s]),
+            "lcnt": int(lcnt[s]),
+            "rcnt": int(rcnt[s]),
+        }
+
+
 def leaf_id_from_segments(tree: PTreeResult, p: jnp.ndarray, layout: PLayout, num_rows: int) -> jnp.ndarray:
     """(N,) int32 leaf index in ORIGINAL row order (via the rowid
     channel) — the GrowResult.leaf_id contract for driver code that needs

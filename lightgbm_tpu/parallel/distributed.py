@@ -120,6 +120,10 @@ def ensure_initialized(config=None, process_id: Optional[int] = None) -> bool:
             _initialized = True
             if jax.process_count() > 1:
                 net.ensure_heartbeat()
+                from ..obs import tracer
+
+                tracer.set_identity(rank=jax.process_index(),
+                                    world_size=jax.process_count())
                 return True
             return False
     except Exception:  # pragma: no cover — private-API drift tolerated
@@ -196,6 +200,12 @@ def ensure_initialized(config=None, process_id: Optional[int] = None) -> bool:
                                    what="backend_init_probe")
     if nproc_seen > 1:
         net.ensure_heartbeat()
+        # stamp rank/world/run_id onto every trace record so `report
+        # merge` can correlate the per-rank JSONLs of this run
+        from ..obs import tracer
+
+        tracer.set_identity(rank=jax.process_index(),
+                            world_size=nproc_seen, run_id=coord)
     return nproc_seen > 1
 
 

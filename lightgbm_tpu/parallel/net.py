@@ -55,6 +55,21 @@ _HB_DIR = "ltpu_hb/"
 _COLLECT_DIR = "ltpu_collect/"
 
 
+def _flight_dump(reason: str, error: Optional[BaseException] = None,
+                 **attrs) -> None:
+    """Flush the crash flight recorder (obs/flight.py) the moment a
+    typed transport failure is about to be raised: the survivor's
+    flush-and-exit path then always leaves a ``<trace>.crash.jsonl``
+    with the final spans before the failure.  No-op when tracing (and
+    therefore the ring) is off; never raises."""
+    try:
+        from ..obs import flight
+
+        flight.dump(reason, error=error, **attrs)
+    except Exception:  # pragma: no cover - dying path must not re-fail
+        pass
+
+
 # ----------------------------------------------------------------------
 # error hierarchy
 # ----------------------------------------------------------------------
@@ -221,6 +236,8 @@ def retry_call(fn: Callable, what: str, retries: Optional[int] = None,
             time.sleep(delays[attempt])
     elapsed = time.monotonic() - t0
     tracer.counter("net.timeout", what=what)
+    _flight_dump("collective_timeout", error=last, what=what,
+                 elapsed_s=round(elapsed, 3))
     raise CollectiveTimeoutError(
         f"{what} failed after {elapsed:.1f}s "
         f"(retries={retries}, deadline={deadline:.0f}s): {last}",
@@ -473,6 +490,7 @@ class PeerWatch:
         except Exception as e:
             # the KV store itself is gone: the coordinator (rank 0)
             # process died — everything routed through it is dead
+            _flight_dump("coordinator_unreachable", error=e)
             raise PeerFailureError(
                 f"distributed KV store unreachable (coordinator dead?): {e}",
                 ranks=(0,),
@@ -486,6 +504,8 @@ class PeerWatch:
             stale = (self._stale_after if self._stale_after is not None
                      else settings().stale_after())
             tracer.event("net.peer_failure", what=what, ranks=dead,
+                         elapsed_s=round(elapsed_s, 3))
+            _flight_dump("peer_failure", what=what, ranks=list(dead),
                          elapsed_s=round(elapsed_s, 3))
             raise PeerFailureError(
                 f"rank(s) {dead} stopped heartbeating during {what} "
@@ -596,6 +616,8 @@ def kv_gather(uid: int, blob: bytes, *, client=None, rank: Optional[int] = None,
                 if watch is not None:
                     watch.check(what, elapsed_s=elapsed)
                 tracer.counter("net.timeout", what=what)
+                _flight_dump("collective_timeout", what=what,
+                             elapsed_s=round(elapsed, 3))
                 raise CollectiveTimeoutError(
                     f"{what} uid={uid}: rank {r} never contributed within "
                     f"{budget:.1f}s (deadline={deadline:.1f}s) but peers "
@@ -608,6 +630,8 @@ def kv_gather(uid: int, blob: bytes, *, client=None, rank: Optional[int] = None,
                 if not _is_deadline_error(e):
                     misses += 1
                     if misses > s.retries:
+                        _flight_dump("coordinator_unreachable", error=e,
+                                     what=what)
                         raise PeerFailureError(
                             f"{what} uid={uid}: KV store unreachable "
                             f"(coordinator dead?): {e}",
@@ -663,6 +687,8 @@ def watchdog_call(fn: Callable, what: str,
             watch.check(what, elapsed_s=elapsed)
         if elapsed >= budget:
             tracer.counter("net.timeout", what=what)
+            _flight_dump("collective_timeout", what=what,
+                         elapsed_s=round(elapsed, 3))
             raise CollectiveTimeoutError(
                 f"{what} did not complete within {budget:.1f}s "
                 f"(deadline={deadline:.1f}s)", elapsed_s=elapsed,

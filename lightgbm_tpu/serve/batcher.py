@@ -16,8 +16,12 @@ Requests whose caller deadline expires while still queued are dropped
 before they waste device time.
 
 Metrics (queue depth, batch occupancy, shed/timeout counts, latency
-quantiles) are kept in-process for ``stats()`` and mirrored to the obs
-tracer when tracing is enabled.
+quantiles) are kept in-process for ``stats()``, mirrored to the obs
+tracer when tracing is enabled, and — always — observed into the
+Prometheus registry (obs/metrics.py) that ``GET /metrics`` scrapes:
+request/row/batch/shed/deadline counters, batch-size and latency
+histograms, and the queue-depth gauge.  Registry updates are plain
+locked float adds, negligible next to a device dispatch.
 """
 
 from __future__ import annotations
@@ -29,7 +33,34 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
-from ..obs import tracer
+from ..obs import metrics, tracer
+
+# shared across batcher instances (a server runs two — converted and
+# raw-score — and Prometheus wants the aggregate; per-batcher detail
+# stays on /stats)
+_M_REQUESTS = metrics.registry.counter(
+    "lightgbm_tpu_serve_requests_total", "predict requests submitted")
+_M_ROWS = metrics.registry.counter(
+    "lightgbm_tpu_serve_rows_total", "predict rows submitted")
+_M_BATCHES = metrics.registry.counter(
+    "lightgbm_tpu_serve_batches_total", "device batches executed")
+_M_SHED = metrics.registry.counter(
+    "lightgbm_tpu_serve_shed_total",
+    "requests shed by the queue-full overload policy (HTTP 503)")
+_M_TIMEOUTS = metrics.registry.counter(
+    "lightgbm_tpu_serve_deadline_expired_total",
+    "requests dropped because their deadline expired while queued (504)")
+_M_ERRORS = metrics.registry.counter(
+    "lightgbm_tpu_serve_errors_total", "device batches that raised")
+_M_QUEUE = metrics.registry.gauge(
+    "lightgbm_tpu_serve_queue_rows", "rows currently queued")
+_M_BATCH_ROWS = metrics.registry.histogram(
+    "lightgbm_tpu_serve_batch_rows", "rows per executed device batch",
+    buckets=metrics.BATCH_BUCKETS)
+_M_LATENCY = metrics.registry.histogram(
+    "lightgbm_tpu_serve_latency_seconds",
+    "request latency, enqueue to completed batch",
+    buckets=metrics.LATENCY_BUCKETS)
 
 
 class ServerOverloaded(RuntimeError):
@@ -114,6 +145,7 @@ class MicroBatcher:
                 raise RuntimeError("batcher is closed")
             if self._queued_rows + rows.shape[0] > self.max_queue_rows:
                 self._counts["shed"] += 1
+                _M_SHED.inc()
                 tracer.counter("serve_shed")
                 raise ServerOverloaded(
                     f"queue holds {self._queued_rows} rows; "
@@ -122,8 +154,11 @@ class MicroBatcher:
                 )
             self._counts["requests"] += 1
             self._counts["rows"] += rows.shape[0]
+            _M_REQUESTS.inc()
+            _M_ROWS.inc(rows.shape[0])
             self._queue.append(req)
             self._queued_rows += rows.shape[0]
+            _M_QUEUE.set(self._queued_rows)
             self._wake.notify()
         # wait past the deadline by a grace period: an in-flight batch
         # holding this request may still complete it
@@ -132,7 +167,9 @@ class MicroBatcher:
             raise req.error
         if req.result is None:
             raise RequestTimeout("request was never executed")
-        self._latency_s.append(time.perf_counter() - req.t_enqueue)
+        lat = time.perf_counter() - req.t_enqueue
+        self._latency_s.append(lat)
+        _M_LATENCY.observe(lat)
         return req.result
 
     # -- batch loop ----------------------------------------------------
@@ -155,6 +192,7 @@ class MicroBatcher:
                         self._queue.popleft()
                         self._queued_rows -= req.rows.shape[0]
                         self._counts["timeouts"] += 1
+                        _M_TIMEOUTS.inc()
                         tracer.counter("serve_request_timeout")
                         req.error = RequestTimeout(
                             "deadline expired while queued")
@@ -183,6 +221,8 @@ class MicroBatcher:
             batch = (taken[0].rows if len(taken) == 1
                      else np.concatenate([r.rows for r in taken], axis=0))
             self._occupancy.append(batch.shape[0])
+            _M_QUEUE.set(self._queued_rows)
+            _M_BATCH_ROWS.observe(batch.shape[0])
             tracer.gauge("serve_queue_depth", float(self._queued_rows))
             tracer.gauge("serve_batch_rows", float(batch.shape[0]))
             try:
@@ -190,8 +230,10 @@ class MicroBatcher:
                                  requests=len(taken)):
                     out = self.predict_fn(batch)
                 self._counts["batches"] += 1
+                _M_BATCHES.inc()
             except BaseException as e:  # predict failure fans out to callers
                 self._counts["errors"] += 1
+                _M_ERRORS.inc()
                 for req in taken:
                     req.error = e
                     req.done.set()

@@ -15,6 +15,12 @@ Endpoints:
   GET  /stats        serving metrics: batcher counters + latency
                      quantiles, bucket-cache compile accounting, queue
                      depth, readiness/drain state, uptime.
+  GET  /metrics      the same signals in Prometheus text format
+                     (obs/metrics.py): request/shed/deadline counters,
+                     batch-size + latency histograms, queue depth,
+                     ready/draining/inflight state, XLA compile
+                     accounting.  Rendering reads host counters only —
+                     a scrape can never trigger an XLA compile.
 
 Shutdown: SIGTERM starts a graceful drain — ``/readyz`` flips to 503,
 new ``/predict`` requests get 503, in-flight microbatches finish
@@ -44,6 +50,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..obs import compilewatch, tracer
+from ..obs.metrics import registry as metrics_registry
 from ..utils.log import Log
 from .artifact import PackedPredictor, PredictorArtifact
 from .batcher import MicroBatcher, RequestTimeout, ServerOverloaded
@@ -130,6 +137,25 @@ class PredictServer(ThreadingHTTPServer):
         self.draining = False
         self._inflight = 0
         self._inflight_cv = threading.Condition()
+        # scrape-time state gauges: evaluated inside /metrics rendering,
+        # zero cost between scrapes (fn re-registration means the latest
+        # server instance in a process owns the gauge)
+        metrics_registry.gauge(
+            "lightgbm_tpu_serve_ready",
+            "1 once the artifact is loaded and warmup completed",
+            fn=lambda: 1.0 if self.ready else 0.0)
+        metrics_registry.gauge(
+            "lightgbm_tpu_serve_draining",
+            "1 while a SIGTERM graceful drain is in progress",
+            fn=lambda: 1.0 if self.draining else 0.0)
+        metrics_registry.gauge(
+            "lightgbm_tpu_serve_inflight_requests",
+            "HTTP predict requests currently being handled",
+            fn=lambda: float(self._inflight))
+        metrics_registry.gauge(
+            "lightgbm_tpu_serve_uptime_seconds",
+            "seconds since this server process started serving",
+            fn=lambda: time.time() - self.t_start)
         super().__init__(addr, _Handler)
 
     # -- in-flight request accounting ----------------------------------
@@ -220,6 +246,11 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply_json(200, {"status": "ready"})
         elif self.path == "/stats":
             self._reply_json(200, self.server.stats())
+        elif self.path == "/metrics":
+            # Prometheus text format; render() never touches jax, so a
+            # scrape storm cannot compile or serialize device work
+            self._reply(200, metrics_registry.render().encode(),
+                        ctype="text/plain; version=0.0.4; charset=utf-8")
         else:
             self._reply_json(404, {"error": f"unknown path {self.path}"})
 

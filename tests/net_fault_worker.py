@@ -18,6 +18,14 @@ modes:
              collective under test); used for the kill -> detect ->
              flush -> auto-resume acceptance proof.  Survivors of a
              peer failure exit with code 75 (cli.EXIT_PEER_FAILURE).
+             With ``LIGHTGBM_TPU_TRACE`` set, the survivor's typed
+             failure additionally flushes the crash flight recorder
+             (obs/flight.py) — the ``report merge``/crash-dump
+             acceptance legs ride this mode.
+  mergetrace — clean 2-rank "training" loop (compute span + hardened
+             barrier per iteration, KV transport) with per-rank traces;
+             MERGETRACE_COMPUTE_S skews one rank into a straggler so
+             the test can assert ``report merge`` attribution.
 """
 
 import json
@@ -70,6 +78,25 @@ jax.config.update("jax_platforms", "cpu")
 assert jax.process_count() == nproc
 
 from lightgbm_tpu.parallel import collect  # noqa: E402
+
+if mode == "mergetrace":
+    # clean run: per-iteration compute (skewed per rank via
+    # MERGETRACE_COMPUTE_S) + the hardened KV barrier, traced per rank —
+    # the `report merge` straggler-attribution acceptance leg
+    from lightgbm_tpu.obs import tracer
+
+    tracer.refresh_from_env()  # LIGHTGBM_TPU_TRACE + rank/world identity
+    assert tracer.enabled, "mergetrace mode needs LIGHTGBM_TPU_TRACE"
+    compute_s = float(os.environ.get("MERGETRACE_COMPUTE_S", "0.02"))
+    for i in range(4):
+        with tracer.iteration(i):
+            with tracer.span("histogram"):
+                time.sleep(compute_s)
+            collect.barrier(tag=f"it{i}")
+    tracer.close()
+    _write({"error": None, "iters": 4})
+    print(f"rank {rank} mergetrace done")
+    sys.exit(0)
 
 if mode in ("gather", "barrier"):
     t_enter = time.time()

@@ -1,0 +1,300 @@
+"""Prometheus metrics-registry tests: registry unit behavior (counter
+monotonicity, cumulative histogram buckets, fn-backed gauges, the
+tracer mirror and its no-double-count rule), exposition text-format
+validity, and the ``GET /metrics`` acceptance contract — scraped during
+a live microbatched load it must stay format-valid with monotone
+counters and consistent histograms, agree with ``/stats`` on shared
+values, and cause ZERO new XLA compiles.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import compilewatch
+from lightgbm_tpu.obs.metrics import (
+    BATCH_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    parse_text_format,
+    registry,
+    sanitize,
+)
+
+
+class TestRegistryUnit:
+    def test_counter_monotone(self):
+        r = MetricsRegistry()
+        c = r.counter("lightgbm_tpu_test_total", "help text")
+        c.inc()
+        c.inc(2.5)
+        assert c.value() == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        # get-or-create: same object by name
+        assert r.counter("lightgbm_tpu_test_total") is c
+
+    def test_gauge_set_and_fn(self):
+        r = MetricsRegistry()
+        g = r.gauge("lightgbm_tpu_test_gauge")
+        g.set(4.0)
+        assert g.value() == 4.0
+        box = {"v": 7.0}
+        g2 = r.gauge("lightgbm_tpu_test_fn_gauge", fn=lambda: box["v"])
+        assert g2.value() == 7.0
+        box["v"] = 9.0
+        assert g2.value() == 9.0  # evaluated at read time
+
+    def test_fn_re_registration_replaces_callback(self):
+        r = MetricsRegistry()
+        r.gauge("lightgbm_tpu_g", fn=lambda: 1.0)
+        g = r.gauge("lightgbm_tpu_g", fn=lambda: 2.0)
+        assert g.value() == 2.0
+
+    def test_type_conflict_raises(self):
+        r = MetricsRegistry()
+        r.counter("lightgbm_tpu_x_total")
+        with pytest.raises(TypeError):
+            r.gauge("lightgbm_tpu_x_total")
+
+    def test_invalid_name_rejected(self):
+        r = MetricsRegistry()
+        with pytest.raises(ValueError):
+            r.counter("has space")
+
+    def test_histogram_cumulative_buckets_sum_count(self):
+        r = MetricsRegistry()
+        h = r.histogram("lightgbm_tpu_h", buckets=(1.0, 2.0, 4.0))
+        for v in (0.5, 1.5, 3.0, 100.0):
+            h.observe(v)
+        text = r.render()
+        fam = parse_text_format(text)["lightgbm_tpu_h"]
+        s = fam["samples"]
+        assert fam["type"] == "histogram"
+        assert s['lightgbm_tpu_h_bucket{le="1"}'] == 1
+        assert s['lightgbm_tpu_h_bucket{le="2"}'] == 2
+        assert s['lightgbm_tpu_h_bucket{le="4"}'] == 3
+        assert s['lightgbm_tpu_h_bucket{le="+Inf"}'] == 4
+        assert s["lightgbm_tpu_h_count"] == 4
+        assert s["lightgbm_tpu_h_sum"] == pytest.approx(105.0)
+
+    def test_render_parses_and_orders_type_before_samples(self):
+        r = MetricsRegistry()
+        r.counter("lightgbm_tpu_a_total", "a").inc()
+        r.gauge("lightgbm_tpu_b", "b").set(1)
+        r.histogram("lightgbm_tpu_c", "c", buckets=(1.0,)).observe(0.5)
+        fams = parse_text_format(r.render())  # raises on malformed output
+        assert set(fams) == {"lightgbm_tpu_a_total", "lightgbm_tpu_b",
+                             "lightgbm_tpu_c"}
+
+    def test_sanitize(self):
+        assert sanitize("net.retry") == "net_retry"
+        assert sanitize("a-b/c") == "a_b_c"
+
+    def test_trace_mirror_maps_and_accumulates(self):
+        r = MetricsRegistry()
+        r.trace_counter("net.retry", 1)
+        r.trace_counter("net.retry", 2)
+        r.trace_gauge("ingest.host_rss_mb", 123.5)
+        snap = r.snapshot()
+        assert snap["lightgbm_tpu_net_retry_total"] == 3
+        assert snap["lightgbm_tpu_ingest_host_rss_mb"] == 123.5
+
+    def test_trace_mirror_never_double_counts_explicit_metrics(self):
+        """The serve layer updates its registry metrics directly AND
+        traces the same signal — the mirror must skip names that are
+        already explicitly instrumented."""
+        r = MetricsRegistry()
+        c = r.counter("lightgbm_tpu_serve_shed_total")
+        c.inc()  # the explicit instrumentation
+        r.trace_counter("serve_shed", 1)  # the mirror of the same event
+        assert r.snapshot()["lightgbm_tpu_serve_shed_total"] == 1
+        # name collision across kinds (serve_batch_rows gauge vs the
+        # explicit histogram) must be skipped, not raise
+        r.histogram("lightgbm_tpu_serve_batch_rows",
+                    buckets=BATCH_BUCKETS).observe(8)
+        r.trace_gauge("serve_batch_rows", 8.0)
+        assert r.snapshot()["lightgbm_tpu_serve_batch_rows"] == 1
+
+    def test_global_registry_has_compile_collectors(self):
+        snap = registry.snapshot()
+        assert "lightgbm_tpu_xla_compiles_total" in snap
+        assert "lightgbm_tpu_xla_compile_seconds_total" in snap
+
+
+@pytest.fixture(scope="module")
+def live_server():
+    """A warmed server on an ephemeral port (the test_serve pattern)."""
+    import tempfile
+
+    from lightgbm_tpu.serve.server import make_server
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(600, 12)
+    y = (X[:, 0] + 0.5 * X[:, 1] - X[:, 2] ** 2 > -0.5).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, params={"min_data_in_leaf": 5})
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1},
+                    ds, num_boost_round=10, verbose_eval=False)
+    path = tempfile.mktemp(suffix=".txt")
+    bst.save_model(path)
+    srv = make_server(path, port=0, warmup_max_rows=256, max_delay_ms=1.0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    host, port = srv.server_address[:2]
+    yield srv, f"http://{host}:{port}", X
+    srv.shutdown()
+    srv.server_close()
+
+
+def _get(base, path):
+    return urllib.request.urlopen(base + path, timeout=30).read().decode()
+
+
+def _post_rows(base, rows):
+    body = "\n".join(json.dumps([float(v) for v in r]) for r in rows)
+    req = urllib.request.Request(base + "/predict", data=body.encode())
+    return urllib.request.urlopen(req, timeout=30).read().decode()
+
+
+class TestMetricsEndpoint:
+    def test_scrape_under_live_load(self, live_server):
+        """The acceptance run: scrape /metrics WHILE a concurrent
+        microbatched load runs.  Every scrape must parse as valid
+        exposition format, counters must be monotone across scrapes,
+        histograms internally consistent, and the scrapes themselves
+        must cause zero new XLA compiles."""
+        srv, base, X = live_server
+        stop = threading.Event()
+        errors = []
+
+        def load():
+            i = 0
+            while not stop.is_set():
+                try:
+                    _post_rows(base, X[i % 500: i % 500 + 7])
+                except Exception as e:  # pragma: no cover
+                    errors.append(e)
+                    return
+                i += 7
+
+        threads = [threading.Thread(target=load, daemon=True)
+                   for _ in range(4)]
+        _post_rows(base, X[:8])  # ensure a warm request precedes scraping
+        compiles_before = compilewatch.snapshot()["backend_compiles"]
+        for t in threads:
+            t.start()
+        scrapes = []
+        try:
+            for _ in range(5):
+                scrapes.append(parse_text_format(_get(base, "/metrics")))
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+        assert not errors, errors
+        assert (compilewatch.snapshot()["backend_compiles"]
+                == compiles_before), "scraping /metrics compiled something"
+
+        names_required = {
+            "lightgbm_tpu_serve_requests_total",
+            "lightgbm_tpu_serve_rows_total",
+            "lightgbm_tpu_serve_batches_total",
+            "lightgbm_tpu_serve_shed_total",
+            "lightgbm_tpu_serve_deadline_expired_total",
+            "lightgbm_tpu_serve_batch_rows",
+            "lightgbm_tpu_serve_latency_seconds",
+            "lightgbm_tpu_serve_queue_rows",
+            "lightgbm_tpu_serve_ready",
+            "lightgbm_tpu_serve_draining",
+            "lightgbm_tpu_xla_compiles_total",
+        }
+        for fams in scrapes:
+            assert names_required <= set(fams), (
+                names_required - set(fams))
+        # counters monotone across consecutive scrapes
+        for a, b in zip(scrapes, scrapes[1:]):
+            for fam, fa in a.items():
+                if fa["type"] != "counter":
+                    continue
+                for key, va in fa["samples"].items():
+                    assert b[fam]["samples"][key] >= va, (fam, key)
+        # histogram internal consistency on the last scrape
+        for fam in ("lightgbm_tpu_serve_batch_rows",
+                    "lightgbm_tpu_serve_latency_seconds"):
+            s = scrapes[-1][fam]["samples"]
+            buckets = sorted(
+                ((k, v) for k, v in s.items() if "_bucket{" in k),
+                key=lambda kv: float("inf") if "+Inf" in kv[0]
+                else float(kv[0].split('le="')[1].rstrip('"}')))
+            values = [v for _, v in buckets]
+            assert values == sorted(values), f"{fam} buckets not cumulative"
+            assert values[-1] == s[f"{fam}_count"]
+        assert scrapes[-1]["lightgbm_tpu_serve_ready"]["samples"][
+            "lightgbm_tpu_serve_ready"] == 1.0
+
+    def test_metrics_agree_with_stats(self, live_server):
+        """Shared values must agree between the human JSON (/stats, per
+        batcher) and the Prometheus surface (aggregate) when the server
+        is quiescent."""
+        srv, base, X = live_server
+        _post_rows(base, X[:5])
+        stats = json.loads(_get(base, "/stats"))
+        fams = parse_text_format(_get(base, "/metrics"))
+
+        def metric(name):
+            return fams[name]["samples"][name]
+
+        both = [stats["batcher"], stats["raw_batcher"]]
+        assert metric("lightgbm_tpu_serve_requests_total") == sum(
+            b["requests"] for b in both)
+        assert metric("lightgbm_tpu_serve_rows_total") == sum(
+            b["rows"] for b in both)
+        assert metric("lightgbm_tpu_serve_shed_total") == sum(
+            b["shed"] for b in both)
+        assert metric("lightgbm_tpu_serve_deadline_expired_total") == sum(
+            b["timeouts"] for b in both)
+        assert metric("lightgbm_tpu_serve_queue_rows") == 0
+        assert metric("lightgbm_tpu_serve_ready") == float(stats["ready"])
+        assert metric("lightgbm_tpu_serve_draining") == float(
+            stats["draining"])
+        assert metric("lightgbm_tpu_serve_inflight_requests") == 0
+        assert fams["lightgbm_tpu_serve_predict_compiles_total"]["samples"][
+            "lightgbm_tpu_serve_predict_compiles_total"
+        ] == stats["compiles"]["predict_compiles"]
+
+    def test_metrics_content_type(self, live_server):
+        srv, base, _ = live_server
+        resp = urllib.request.urlopen(base + "/metrics", timeout=30)
+        assert resp.headers["Content-Type"].startswith(
+            "text/plain; version=0.0.4")
+
+
+class TestEndOfTrainDump:
+    def test_cli_dump_knob(self, tmp_path, monkeypatch):
+        """LIGHTGBM_TPU_METRICS=path: the CLI writes a valid exposition
+        dump at end of train, carrying the compile collectors."""
+        import os
+
+        from lightgbm_tpu.cli import main
+
+        rng = np.random.RandomState(0)
+        X = rng.randn(300, 4)
+        y = (X[:, 0] > 0).astype(np.float64)
+        data = tmp_path / "train.tsv"
+        np.savetxt(data, np.column_stack([y, X]), fmt="%.10g",
+                   delimiter="\t")
+        out = tmp_path / "model.txt"
+        mpath = tmp_path / "metrics.txt"
+        monkeypatch.setenv("LIGHTGBM_TPU_METRICS", str(mpath))
+        rc = main([f"data={data}", f"output_model={out}", "task=train",
+                   "objective=binary", "num_trees=2", "num_leaves=4",
+                   "verbose=-1"])
+        assert rc == 0 and os.path.exists(mpath)
+        fams = parse_text_format(mpath.read_text())
+        assert "lightgbm_tpu_xla_compiles_total" in fams

@@ -139,12 +139,15 @@ def test_sigkill_mid_ckpt_barrier_flush_exit_and_bitidentical_resume(tmp_path):
     def run_pair(tag, ckdir, fault_rank=None):
         out = str(tmp_path / tag)
         port = _free_port()
-        procs = [
-            _spawn(r, 2, port, out, "train", args=(ckdir,),
-                   extra_env={"LIGHTGBM_TPU_FAULT": "die:2"}
-                   if r == fault_rank else None)
-            for r in range(2)
-        ]
+        procs = []
+        for r in range(2):
+            # per-rank run trace: the survivor's typed failure must
+            # flush the crash flight recorder next to it
+            extra = {"LIGHTGBM_TPU_TRACE": out + f".rank{r}.trace.jsonl"}
+            if r == fault_rank:
+                extra["LIGHTGBM_TPU_FAULT"] = "die:2"
+            procs.append(_spawn(r, 2, port, out, "train", args=(ckdir,),
+                                extra_env=extra))
         logs = [p.communicate(timeout=420)[0] for p in procs]
         return out, procs, logs
 
@@ -159,6 +162,9 @@ def test_sigkill_mid_ckpt_barrier_flush_exit_and_bitidentical_resume(tmp_path):
         assert fh.read() == ref_model
     assert _result(out_ref, 0)["resume_from"] is None
 
+    assert not os.path.exists(out_ref + ".rank0.trace.crash.jsonl"), \
+        "clean run must not leave a crash dump"
+
     out_k, procs, logs = run_pair("kill", ck, fault_rank=1)
     assert procs[1].returncode == -signal.SIGKILL, logs[1][-2000:]
     assert procs[0].returncode == 75, logs[0][-2000:]  # EXIT_PEER_FAILURE
@@ -167,6 +173,22 @@ def test_sigkill_mid_ckpt_barrier_flush_exit_and_bitidentical_resume(tmp_path):
     assert res["elapsed"] <= DETECT_BOUND, res
     assert not os.path.exists(out_k + ".rank0.txt"), \
         "killed run must not have produced a model"
+    # crash flight recorder (ISSUE 7 acceptance): the survivor's typed
+    # failure left a flushed .crash.jsonl containing the final spans
+    # before the failure and the net.peer_failure event
+    crash = out_k + ".rank0.trace.crash.jsonl"
+    assert os.path.exists(crash), \
+        "survivor left no flight-recorder dump"
+    recs = [json.loads(l) for l in open(crash) if l.strip()]
+    assert recs[0]["kind"] == "flight", recs[0]
+    assert recs[0]["reason"] == "peer_failure", recs[0]
+    assert recs[0]["rank"] == 0 and recs[0]["world"] == 2, recs[0]
+    assert any(r.get("ev") == "span" for r in recs[1:]), \
+        "crash dump carries no spans"
+    assert any(r.get("ev") == "event"
+               and r.get("name") == "net.peer_failure"
+               and 1 in r.get("ranks", []) for r in recs[1:]), \
+        "crash dump missing the net.peer_failure event"
 
     out_r, procs, logs = run_pair("resume", ck)
     assert all(p.returncode == 0 for p in procs), "\n".join(logs)
@@ -175,6 +197,53 @@ def test_sigkill_mid_ckpt_barrier_flush_exit_and_bitidentical_resume(tmp_path):
         assert res["resume_from"] == 3, res  # iter-3 ckpt survived the kill
         with open(out_r + f".rank{r}.txt") as fh:
             assert fh.read() == ref_model, f"rank {r} diverged after resume"
+
+
+@pytest.mark.netfault
+def test_report_merge_attributes_straggler_on_real_2rank_run(tmp_path):
+    """ISSUE 7 acceptance: `report merge` over a REAL 2-rank run
+    (subprocess pair, KV transport) produces a per-rank per-phase
+    timeline and names the straggler rank with barrier-wait
+    attribution.  Rank 1's per-iteration compute is ~6x rank 0's, so
+    rank 0 parks in the hardened barrier behind it."""
+    out = str(tmp_path / "m")
+    port = _free_port()
+    procs = [
+        _spawn(r, 2, port, out, "mergetrace",
+               extra_env={
+                   "LIGHTGBM_TPU_TRACE": out + f".rank{r}.trace.jsonl",
+                   "MERGETRACE_COMPUTE_S": "0.3" if r == 1 else "0.05",
+               })
+        for r in range(2)
+    ]
+    logs = [p.communicate(timeout=240)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs), "\n".join(
+        l[-2000:] for l in logs)
+    assert all(_result(out, r)["error"] is None for r in (0, 1))
+
+    from lightgbm_tpu.obs import report
+
+    paths = [out + f".rank{r}.trace.jsonl" for r in (0, 1)]
+    by_rank = report.load_rank_traces(paths)
+    assert set(by_rank) == {0, 1}, "rank identity missing from records"
+    m = report.merge_summary(by_rank)
+    assert m["aligned_iterations"] == 4
+    assert m["world_size"] == 2
+    assert m["run_id"], "run_id missing (coordinator address fallback)"
+    # straggler attribution: rank 1 computes, rank 0 waits
+    st = m["straggler"]
+    assert st["rank"] == 1, m
+    assert st["slowest_rank_share"] > 0.5, m
+    assert st["wait_behind_straggler_s"] > 0, m
+    assert (m["per_rank"][0]["barrier_wait_s"]
+            > m["per_rank"][1]["barrier_wait_s"]), m
+    # per-phase per-rank timeline: the compute phase and the barrier
+    # phase are both attributed per rank
+    assert "histogram" in m["phases"] and "net.barrier" in m["phases"], m
+    assert m["phases"]["histogram"][1] > m["phases"]["histogram"][0], m
+    rendered = report.render_merge(m)
+    assert "straggler: rank 1" in rendered
+    assert "barrier wait" in rendered
 
 
 # ----------------------------------------------------------------------

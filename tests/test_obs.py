@@ -7,6 +7,7 @@ and the JitWatch retrace detector.
 
 import json
 import os
+import re
 import time
 
 import numpy as np
@@ -338,3 +339,362 @@ class TestDisabledOverheadEndToEnd:
                   lgb.Dataset(X, label=y), num_boost_round=2,
                   verbose_eval=False)
         assert not tracer.enabled and tracer.path is None
+
+    def test_tracing_off_does_zero_tracer_work(self, monkeypatch):
+        """The overhead guard (ISSUE 7 satellite): training with tracing
+        fully off must not allocate a flight ring nor process a single
+        tracer-side record.  Pinned on the tracer WORK COUNTER (every
+        emitted/mirrored record increments it), not wall clock, so a
+        widened hot path cannot hide in timing noise."""
+        from lightgbm_tpu.obs import flight, tracer
+
+        monkeypatch.delenv("LIGHTGBM_TPU_TRACE", raising=False)
+        monkeypatch.delenv("LIGHTGBM_TPU_AUDIT", raising=False)
+        tracer.close()
+        tracer.path = None
+        tracer.refresh_from_env()
+        work_before = tracer.work_ops
+        X, y = _toy()
+        for force in ("0", "force"):  # mask path AND the fused path
+            monkeypatch.setenv("LIGHTGBM_TPU_PGROW", force)
+            lgb.train({"objective": "binary", "num_leaves": 7,
+                       "verbose": -1},
+                      lgb.Dataset(X, label=y), num_boost_round=2,
+                      verbose_eval=False)
+        assert tracer.work_ops == work_before, (
+            "tracer-side work happened with tracing off")
+        assert flight.recorder.ring is None, (
+            "flight ring allocated with tracing off")
+
+
+class TestFlightRecorder:
+    def test_ring_bounded_and_dump_contents(self, tmp_path, monkeypatch):
+        from lightgbm_tpu.obs import flight
+        from lightgbm_tpu.obs.trace import Tracer
+
+        monkeypatch.setenv("LIGHTGBM_TPU_FLIGHT_RING", "64")
+        tr = Tracer()
+        tr.configure(str(tmp_path / "t.jsonl"))
+        assert flight.recorder.ring is not None
+        assert flight.recorder.ring.maxlen == 64
+        for i in range(200):
+            tr.event("tick", i=i)
+        tr.event("boom", last=True)
+        p = flight.recorder.dump("unit_test", error=RuntimeError("x"),
+                                 extra=1)
+        assert p == str(tmp_path / "t.crash.jsonl")
+        recs = _read(p)
+        meta = recs[0]
+        assert meta["ev"] == "meta" and meta["kind"] == "flight"
+        assert meta["reason"] == "unit_test"
+        assert meta["error"] == "RuntimeError: x" and meta["extra"] == 1
+        # bounded: ring capacity + the meta line, keeping the NEWEST
+        assert len(recs) == 65
+        assert recs[-1]["name"] == "boom"
+        assert all(r["name"] == "tick" and r["i"] >= 136
+                   for r in recs[1:-1])
+        tr.close()
+        assert flight.recorder.ring is None  # deactivated with the tracer
+        assert flight.recorder.dump("after_close") is None
+
+    def test_net_failure_dumps_ring(self, tmp_path, monkeypatch):
+        """The net.py wiring: a typed PeerFailureError raise flushes the
+        ring — the survivor's crash dump contains the final records
+        before the failure (here driven through PeerWatch.check with a
+        fake KV client)."""
+        from lightgbm_tpu.obs import flight, tracer
+        from lightgbm_tpu.parallel.net import PeerFailureError, PeerWatch
+
+        monkeypatch.setenv("LIGHTGBM_TPU_TRACE",
+                           str(tmp_path / "net.jsonl"))
+        tracer.refresh_from_env()
+        try:
+            with tracer.span("net.heartbeat", rank=0):
+                pass
+
+            class DeadKV:
+                def key_value_dir_get(self, prefix):
+                    return [("ltpu_hb/1/5", "5")]
+
+            clock = {"t": 0.0}
+            watch = PeerWatch(DeadKV(), rank=0, nproc=2, stale_after_s=1.0,
+                              time_fn=lambda: clock["t"])
+            watch.ages()
+            clock["t"] = 10.0  # rank 1's key set frozen for 10 s
+            with pytest.raises(PeerFailureError):
+                watch.check("unit_collective")
+        finally:
+            crash = str(tmp_path / "net.crash.jsonl")
+            found = os.path.exists(crash)
+            recs = _read(crash) if found else []
+            tracer.close()
+            tracer.path = None
+        assert found, "typed failure left no crash dump"
+        assert recs[0]["reason"] == "peer_failure"
+        assert any(r.get("ev") == "span" and r.get("name") == "net.heartbeat"
+                   for r in recs)
+        assert any(r.get("ev") == "event"
+                   and r.get("name") == "net.peer_failure" for r in recs)
+
+    def test_sigusr1_dumps(self, tmp_path, monkeypatch):
+        import signal
+
+        from lightgbm_tpu.obs import flight, tracer
+
+        monkeypatch.setenv("LIGHTGBM_TPU_TRACE",
+                           str(tmp_path / "s.jsonl"))
+        tracer.refresh_from_env()
+        try:
+            tracer.event("before_signal")
+            assert flight.install_signal_handler()
+            os.kill(os.getpid(), signal.SIGUSR1)
+            crash = str(tmp_path / "s.crash.jsonl")
+            recs = _read(crash)
+        finally:
+            signal.signal(signal.SIGUSR1, signal.SIG_DFL)
+            tracer.close()
+            tracer.path = None
+        assert recs[0]["reason"] == "sigusr1"
+        assert any(r.get("name") == "before_signal" for r in recs)
+
+
+class TestTraceIdentity:
+    def test_records_carry_rank_world_run_id(self, tmp_path):
+        from lightgbm_tpu.obs.trace import Tracer
+
+        tr = Tracer()
+        tr.set_identity(rank=3, world_size=8, run_id="host:1234")
+        tr.configure(str(tmp_path / "i.jsonl"))
+        with tr.span("histogram"):
+            pass
+        tr.counter("net.retry")
+        tr.close()
+        recs = _read(str(tmp_path / "i.jsonl"))
+        assert recs, "no records written"
+        for r in recs:
+            assert r["rank"] == 3 and r["world"] == 8
+            assert r["run_id"] == "host:1234"
+
+    def test_single_process_records_stay_clean(self, fresh_tracer):
+        tr = fresh_tracer
+        tr.event("x")
+        tr.close()
+        recs = _read(tr.path)
+        assert all("rank" not in r and "world" not in r for r in recs)
+
+
+class TestReportGarbageLines:
+    def test_garbage_lines_skip_with_warning(self, tmp_path, capsys):
+        """Crash-cut traces: unparsable lines ANYWHERE in the file (not
+        just a torn tail) must be skipped with a warning, never raise."""
+        p = str(tmp_path / "g.jsonl")
+        with open(p, "w") as f:
+            f.write('{"ev":"meta","version":1}\n')
+            f.write("\x00\x00garbage not json\n")
+            f.write('{"ev":"iter","iter":0,"wall_s":0.5,"phases":{}}\n')
+            f.write('["not", "an", "object"]\n')
+            f.write('{"ev":"iter","iter":1,"wa')  # torn tail
+        recs = report.load_trace(p)
+        err = capsys.readouterr().err
+        assert len(recs) == 2
+        assert err.count("warning:") == 3
+        assert "skipping" in err
+        summary = report.summarize(recs)
+        assert summary["iterations"] == 1
+
+    def test_report_cli_survives_garbage(self, tmp_path, capsys):
+        from lightgbm_tpu.cli import main
+
+        p = str(tmp_path / "g.jsonl")
+        with open(p, "w") as f:
+            f.write("not json at all\n")
+            f.write('{"ev":"iter","iter":0,"wall_s":0.1,"phases":{}}\n')
+        assert main(["report", p]) == 0
+        out = capsys.readouterr().out
+        assert "iterations: 1" in out
+
+
+def _make_rank_trace(tmp_path, rank, compute_s, wait_s, iters=3):
+    """Synthesize one rank's trace with controlled compute/wait spans."""
+    from lightgbm_tpu.obs.trace import Tracer
+
+    tr = Tracer()
+    tr.set_identity(rank=rank, world_size=2, run_id="merge:test")
+    path = str(tmp_path / f"rank{rank}.jsonl")
+    tr.configure(path)
+    for i in range(iters):
+        with tr.iteration(i):
+            with tr.span("histogram"):
+                time.sleep(compute_s)
+            with tr.span("net.barrier", tag=f"it{i}"):
+                with tr.span("net.allgather", transport="kv", bytes=4):
+                    time.sleep(wait_s)
+    tr.close()
+    return path
+
+
+class TestReportMerge:
+    def test_straggler_attribution(self, tmp_path):
+        # rank 1 computes 4x longer; rank 0 waits in the barrier
+        _make_rank_trace(tmp_path, 0, compute_s=0.01, wait_s=0.04)
+        _make_rank_trace(tmp_path, 1, compute_s=0.04, wait_s=0.01)
+        by = report.load_rank_traces(
+            [str(tmp_path / "rank0.jsonl"), str(tmp_path / "rank1.jsonl")])
+        m = report.merge_summary(by)
+        assert m["ranks"] == [0, 1]
+        assert m["world_size"] == 2
+        assert m["run_id"] == "merge:test"
+        assert m["aligned_iterations"] == 3
+        st = m["straggler"]
+        assert st["rank"] == 1
+        assert st["slowest_rank_share"] > 0.5
+        assert st["slowest_in_iters"] == 3
+        # barrier-wait attribution: the FAST rank carries the wait
+        assert (m["per_rank"][0]["barrier_wait_s"]
+                > m["per_rank"][1]["barrier_wait_s"])
+        # nested barrier/allgather must not double count: per-iteration
+        # wait can never exceed the iteration wall
+        for t in m["timeline"]:
+            for r in (0, 1):
+                assert t["wait_s"][r] <= t["wall_s"][r] + 1e-9
+        # per-phase per-rank timeline includes the compute phase
+        assert "histogram" in m["phases"]
+        assert m["phases"]["histogram"][1] > m["phases"]["histogram"][0]
+
+    def test_alignment_shrinks_to_common_iterations(self, tmp_path):
+        """A rank whose trace was cut short (crash) only contributes the
+        iterations every rank completed."""
+        _make_rank_trace(tmp_path, 0, 0.005, 0.005, iters=5)
+        _make_rank_trace(tmp_path, 1, 0.005, 0.005, iters=3)
+        by = report.load_rank_traces(
+            [str(tmp_path / "rank0.jsonl"), str(tmp_path / "rank1.jsonl")])
+        m = report.merge_summary(by)
+        assert m["aligned_iterations"] == 3
+        assert m["per_rank"][0]["iterations"] == 5
+
+    def test_merge_cli_renders_and_json(self, tmp_path, capsys):
+        from lightgbm_tpu.cli import main
+
+        _make_rank_trace(tmp_path, 0, 0.002, 0.01)
+        _make_rank_trace(tmp_path, 1, 0.01, 0.002)
+        assert main(["report", "merge", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "cross-rank report" in out
+        assert "straggler: rank 1" in out
+        assert "barrier wait" in out
+        assert main(["report", "merge", str(tmp_path), "--json"]) == 0
+        m = json.loads(capsys.readouterr().out)
+        assert m["straggler"]["rank"] == 1
+
+    def test_mismatched_run_ids_warn(self, tmp_path, capsys):
+        from lightgbm_tpu.obs.trace import Tracer
+
+        for rank, rid in ((0, "run:a"), (1, "run:b")):
+            tr = Tracer()
+            tr.set_identity(rank=rank, world_size=2, run_id=rid)
+            tr.configure(str(tmp_path / f"rank{rank}.jsonl"))
+            with tr.iteration(0):
+                pass
+            tr.close()
+        by = report.load_rank_traces(
+            [str(tmp_path / "rank0.jsonl"), str(tmp_path / "rank1.jsonl")])
+        report.merge_summary(by)
+        assert "distinct run_ids" in capsys.readouterr().err
+
+
+class TestReportDiff:
+    def test_identical_and_divergent_and_truncated(self, tmp_path,
+                                                   capsys):
+        from lightgbm_tpu.cli import main
+
+        a = str(tmp_path / "a.jsonl")
+        b = str(tmp_path / "b.jsonl")
+        recs = [{"ev": "split", "it": 0, "s": 0, "feat": 3, "gain": 1.5},
+                {"ev": "split", "it": 0, "s": 1, "feat": 2, "gain": 0.5},
+                {"ev": "tree", "it": 0, "leaves": 3,
+                 "values": [0.1, 0.2, 0.3]}]
+        with open(a, "w") as f:
+            f.writelines(json.dumps(r) + "\n" for r in recs)
+        with open(b, "w") as f:
+            f.writelines(json.dumps(r) + "\n" for r in recs)
+        assert main(["report", "diff", a, b]) == 0
+        capsys.readouterr()
+
+        recs2 = [dict(r) for r in recs]
+        recs2[1] = dict(recs2[1], feat=7, gain=0.25)
+        with open(b, "w") as f:
+            f.writelines(json.dumps(r) + "\n" for r in recs2)
+        assert main(["report", "diff", a, b]) == 1
+        out = capsys.readouterr().out
+        assert "diverge at record 1" in out
+        assert "feat: a=2  b=7" in out
+        assert "gain: a=0.5  b=0.25" in out
+
+        # truncated stream: divergence at the cut
+        with open(b, "w") as f:
+            f.writelines(json.dumps(r) + "\n" for r in recs[:1])
+        assert main(["report", "diff", a, b]) == 1
+        assert "ends early" in capsys.readouterr().out
+
+    def test_values_divergence_names_the_leaf(self, tmp_path, capsys):
+        from lightgbm_tpu.cli import main
+
+        a = str(tmp_path / "a.jsonl")
+        b = str(tmp_path / "b.jsonl")
+        ra = {"ev": "tree", "it": 2, "k": 0, "leaves": 3,
+              "values": [0.1, 0.2, 0.3]}
+        rb = dict(ra, values=[0.1, 0.25, 0.3])
+        with open(a, "w") as f:
+            f.write(json.dumps(ra) + "\n")
+        with open(b, "w") as f:
+            f.write(json.dumps(rb) + "\n")
+        assert main(["report", "diff", a, b]) == 1
+        out = capsys.readouterr().out
+        assert "values[1]: a=0.2  b=0.25" in out
+        assert "it=2" in out
+
+
+class TestNameRegistryLint:
+    """Span/counter/gauge/event and Prometheus metric names are an
+    interface (dashboards, report merge, the bench JSON key on them):
+    every literal name emitted from the source must appear in the
+    docs/OBSERVABILITY.md name registry."""
+
+    TRACER_PAT = re.compile(
+        r'tracer\.(?:span|counter|gauge|event)\(\s*[\'"]([A-Za-z0-9_.]+)[\'"]')
+    METRIC_PAT = re.compile(
+        r'(?:registry|reg)\.(?:counter|gauge|histogram)\(\s*\n?\s*'
+        r'[\'"]([A-Za-z0-9_:]+)[\'"]')
+
+    def _source_names(self):
+        import pathlib
+
+        repo = pathlib.Path(__file__).resolve().parent.parent
+        names = {}
+        files = list((repo / "lightgbm_tpu").rglob("*.py"))
+        files.append(repo / "bench.py")
+        for p in files:
+            src = p.read_text()
+            for name in self.TRACER_PAT.findall(src):
+                names.setdefault(name, str(p))
+            for name in self.METRIC_PAT.findall(src):
+                names.setdefault(name, str(p))
+        assert len(names) > 40, "lint scan found suspiciously few names"
+        return names, repo
+
+    def test_every_emitted_name_is_documented(self):
+        names, repo = self._source_names()
+        doc = (repo / "docs" / "OBSERVABILITY.md").read_text()
+        missing = {n: f for n, f in names.items() if f"`{n}`" not in doc}
+        assert not missing, (
+            "emitted observability names missing from the "
+            "docs/OBSERVABILITY.md name registry table (names are an "
+            f"interface — document them): {missing}")
+
+    def test_lint_catches_an_undocumented_name(self, tmp_path):
+        """The lint must actually bite: a name not in the doc table is
+        reported missing."""
+        doc = "| `documented.name` | span | x | y |"
+        names = {"documented.name": "a.py", "brand.new.span": "b.py"}
+        missing = {n for n in names if f"`{n}`" not in doc}
+        assert missing == {"brand.new.span"}
