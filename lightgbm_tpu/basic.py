@@ -353,7 +353,9 @@ class Dataset:
         return self.data.shape[1] if self.data is not None else 0
 
     def save_binary(self, filename: str) -> "Dataset":
-        self.construct().save_binary(filename)
+        # record which source file the cache came from, so a later load
+        # can refuse the cache when that file changes underneath it
+        self.construct().save_binary(filename, source_path=self.data_path)
         return self
 
     def subset(self, used_indices, params=None) -> "Dataset":

@@ -17,6 +17,9 @@ from .gbdt import GBDT
 
 class DART(GBDT):
     supports_partitioned = False  # host-side drop/normalize hooks
+    # dropping re-scores dropped trees over the whole train set each
+    # iteration — under streaming that would multiply matrix passes
+    supports_ooc = False
 
     def init(self, config, train_set, objective, training_metrics=()):
         super().init(config, train_set, objective, training_metrics)

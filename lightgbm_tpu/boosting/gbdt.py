@@ -58,6 +58,11 @@ class GBDT:
     supports_partitioned = True
     # data-parallel fused path (GOSS needs a global top_k, not sharded yet)
     supports_partitioned_data = True
+    # out-of-core streaming (boosting/ooc.py): needs the serial mask
+    # grower's replayable split loop.  DART opts out — its drop state
+    # re-scores dropped trees over the full matrix every iteration,
+    # which would multiply streaming passes.
+    supports_ooc = True
 
     def __init__(self):
         self.models: List[Tree] = []
@@ -109,8 +114,34 @@ class GBDT:
 
         enable_compile_cache()
 
+        # out-of-core routing decides BEFORE the matrix upload: when the
+        # streamed path is on, the (N, F) bin matrix never becomes
+        # device-resident (self.bins stays None) and only the per-row
+        # vectors live on device.
+        from .ooc import resolve_out_of_core
+
+        self.ooc = None
+        ooc_on, ooc_chunk_rows, ooc_why = resolve_out_of_core(config, train_set)
+        if ooc_on:
+            forced = "forced" in ooc_why
+            unsupported = None
+            if config.tree_learner.lower() != "serial":
+                unsupported = (
+                    f"tree_learner={config.tree_learner} (serial only)")
+            elif not self.supports_ooc:
+                unsupported = f"boosting type {type(self).__name__}"
+            if unsupported is not None:
+                if forced:
+                    Log.fatal(
+                        "out_of_core=true is not supported with %s",
+                        unsupported)
+                Log.warning(
+                    "out-of-core auto-routing (%s) skipped: not supported "
+                    "with %s; training in-memory", ooc_why, unsupported)
+                ooc_on = False
+
         # device-resident training state
-        self.bins = jnp.asarray(train_set.binned)
+        self.bins = None if ooc_on else jnp.asarray(train_set.binned)
         self.num_bins = int(train_set.max_num_bin)
         self.meta = FeatureMeta.from_dataset(train_set)
         self.hyper = SplitHyper.from_config(config)
@@ -127,7 +158,13 @@ class GBDT:
         learner_type = config.tree_learner.lower()
         self.learner = None
         self.ptrainer = None
-        if learner_type in ("data", "feature", "voting"):
+        if ooc_on:
+            from .ooc import OocTrainer
+
+            self.ooc = OocTrainer(
+                train_set, config, self.grow_params, ooc_chunk_rows)
+            self.learner = self.ooc
+        elif learner_type in ("data", "feature", "voting"):
             import jax as _jax
 
             from ..parallel import ShardedLearner, make_mesh
@@ -528,6 +565,13 @@ class GBDT:
         """Full binned traversal on the training set (used by rollback/DART
         where the grower's partition is no longer available)."""
         arrays = stack_trees([tree])
+        if self.bins is None:
+            # out-of-core: traversal is per-row, so streaming it over the
+            # chunk grid is exact
+            self.scores = self.scores.at[k].set(
+                self.ooc.add_tree_scores(self.scores[k], arrays)
+            )
+            return
         self.scores = self.scores.at[k].add(
             predict_binned(
                 self.bins,

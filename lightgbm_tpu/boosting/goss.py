@@ -24,6 +24,11 @@ class GOSS(GBDT):
     # data-parallel GOSS samples per shard, matching the reference's
     # per-machine local TopK (goss.hpp Bagging over the local partition)
     supports_partitioned_data = True
+    # out-of-core composes with GOSS for free: the |g*h| scoring, device
+    # top_k and Bernoulli rest all run on the resident (K, N) vectors —
+    # the sampled select mask reaches the streamed histograms unchanged,
+    # even when the keep set spans chunk boundaries
+    supports_ooc = True
 
     def init(self, config, train_set, objective, training_metrics=()):
         super().init(config, train_set, objective, training_metrics)

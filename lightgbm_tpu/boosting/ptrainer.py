@@ -315,6 +315,28 @@ class PartitionedTrainer:
             def _live_iter(t, carry):
                 (p, recs, stopped, delta, last_kept) = carry
                 it = iter0 + t
+                # ---- canonical row order at every tree start.  The
+                # partition layout a tree leaves behind depends on HOW it
+                # was grown: the level grower speculatively partitions
+                # whole candidate levels (including splits best-first
+                # acceptance never takes), so LEVELGROW=1 and =0 leave
+                # different physical row orders even when they build the
+                # identical tree — and the NEXT tree's histogram float
+                # summation order then differs (the 1-ULP model
+                # divergence pinned by tests/test_audit.py).  One gather
+                # back to original row order per tree makes every tree's
+                # numerics independent of the previous tree's partition
+                # history (it also pins the positional bagging/GOSS draws
+                # below to original rows).  The positional carries
+                # (pending delta, rollback snapshot) are re-mapped
+                # through the SAME rowid so they stay aligned.
+                rowid = p[lay.ROWID, :n]
+                delta = jnp.zeros((n,), jnp.float32).at[rowid].set(delta)
+                last_kept = jnp.zeros((n,), jnp.float32).at[rowid].set(last_kept)
+                inv = jnp.zeros((n,), jnp.int32).at[rowid].set(
+                    jnp.arange(n, dtype=jnp.int32))
+                p = jax.lax.dynamic_update_slice(
+                    p, jnp.take(p[:, :n], inv, axis=1), (0, 0))
                 # disjoint purpose-tagged key streams: fold a purpose
                 # constant (0=bagging, 1=feature, 2=GOSS) before the
                 # iteration number so no two draws share a subkey
@@ -598,21 +620,43 @@ class PartitionedTrainer:
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def score(p, starts, cnts, num_splits, values):
-            # segment_values inlined over the explicit (starts, cnts)
+            # segment_values inlined over the explicit (starts, cnts) —
+            # the same EXACT integer-rank gather as ops.pgrow
+            # .segment_values (a float range-add cumsum leaves
+            # position-dependent 1-ULP residue inside segments; see that
+            # docstring), so traced scores match the fused path's bit
+            # for bit
             active = jnp.arange(L) <= num_splits
             v = jnp.where(active, values, 0.0)
-            s = jnp.where(active, starts, n)
-            e = jnp.where(active, starts + cnts, n)
-            line = jnp.zeros((n + 1,), jnp.float32).at[s].add(v).at[e].add(-v)
-            delta = jnp.cumsum(line)[:n]
+            s = jnp.where(active & (cnts > 0), starts, n)
+            marks = jnp.zeros((n + 1,), jnp.int32).at[s].add(1)
+            rank = jnp.cumsum(marks)[:n] - 1
+            order = jnp.argsort(s)
+            delta = jnp.take(v, jnp.take(order, jnp.clip(rank, 0, L - 1)))
             return score_add(p, lay, delta, 0, num_rows=n,
                              interpret=interp), delta
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def canon(p, lt):
+            # canonical row order at tree start — the traced twin of the
+            # fused _live_iter's gather: makes every tree's numerics (and
+            # the positional bagging draw) independent of the previous
+            # tree's partition layout, and keeps the positional rollback
+            # snapshot aligned through the reorder
+            rowid = p[lay.ROWID, :n]
+            lt = jnp.zeros((n,), jnp.float32).at[rowid].set(lt)
+            inv = jnp.zeros((n,), jnp.int32).at[rowid].set(
+                jnp.arange(n, dtype=jnp.int32))
+            p = jax.lax.dynamic_update_slice(
+                p, jnp.take(p[:, :n], inv, axis=1), (0, 0))
+            return p, lt
 
         return {
             "update": JitWatch(upd, name="ptrainer.traced.update"),
             "partition": JitWatch(part, name="ptrainer.traced.partition"),
             "find": JitWatch(find, name="ptrainer.traced.find"),
             "score": JitWatch(score, name="ptrainer.traced.score"),
+            "canon": JitWatch(canon, name="ptrainer.traced.canon"),
         }
 
     def train_chunk_traced(self, T: int, lr: float, iter0: int):
@@ -632,10 +676,10 @@ class PartitionedTrainer:
         Same tree semantics as the fused classic path — bit-identical to
         a LIGHTGBM_TPU_LEVELGROW=0 fused chunk (the per-split selection
         below is the same bookkeeping ``grow_tree_partitioned`` replays).
-        Against the LEVEL-batched fused path, bagged runs can diverge in
-        the module-documented way: the Bernoulli bag mask is drawn over
-        PHYSICAL row positions, and level_stream/split_stream order
-        children rows differently — same distribution, different stream.
+        The canonical-row-order gather at each tree start (the fused
+        path's tree-start canonicalization, mirrored here) pins the
+        positional Bernoulli bag mask to original rows, so bagged runs
+        match BOTH fused modes bit for bit as well.
         Per-split dispatch overhead is the documented price of
         attribution, which is why this mode is opt-in
         (LIGHTGBM_TPU_TRACE_PHASES).  K == 1, non-GOSS only — callers
@@ -676,6 +720,17 @@ class PartitionedTrainer:
         for t in range(T):
             it = iter0 + t
             with tracer.iteration(it, mode="traced") as irec:
+                # canonical row order at tree start (see the fused
+                # _live_iter): partition-history-independent numerics +
+                # original-row-pinned bagging draws; the rollback
+                # snapshot rides through the same reorder
+                self.p, lt = progs["canon"](
+                    self.p,
+                    self._last_tree if self._last_tree is not None
+                    else zeros_n,
+                )
+                if self._last_tree is not None:
+                    self._last_tree = lt
                 if bag_on:
                     bkey = jax.random.fold_in(
                         jax.random.fold_in(key, 0), it // bag_freq

@@ -77,6 +77,10 @@ _FP_VOLATILE = {
     "checkpoint_dir", "checkpoint_freq", "checkpoint_keep",
     "checkpoint_resume", "is_training_metric", "pred_early_stop",
     "pred_early_stop_freq", "pred_early_stop_margin",
+    # prefetch depth only changes pipelining, never the math (the
+    # math-relevant out_of_core/ooc_chunk_rows stay fingerprinted, and
+    # the chunk grid itself is checked via meta["ooc_schedule"])
+    "ooc_prefetch_depth",
 }
 
 
@@ -104,12 +108,18 @@ def data_fingerprint(binned_ds) -> str:
     cached = getattr(binned_ds, "_ckpt_fingerprint", None)
     if cached is not None:
         return cached
-    binned = np.ascontiguousarray(np.asarray(binned_ds.binned))
-    crc = zlib.crc32(binned.tobytes())
+    binned = np.asarray(binned_ds.binned)
+    # block-wise CRC: chunked zlib.crc32 equals the whole-buffer value,
+    # and never materializes a memmapped (out-of-core) matrix
+    crc = 0
+    step = 65536
+    for s in range(0, binned.shape[0], step):
+        crc = zlib.crc32(
+            np.ascontiguousarray(binned[s: s + step]).tobytes(), crc)
     label = binned_ds.metadata.label
     if label is not None:
         crc = zlib.crc32(np.ascontiguousarray(np.asarray(label)).tobytes(), crc)
-    fp = f"{binned.shape[0]}x{binned.shape[1]}:{crc:08x}"
+    fp = f"{binned.shape[0]}x{binned.shape[1]}:{crc & 0xFFFFFFFF:08x}"
     binned_ds._ckpt_fingerprint = fp
     return fp
 
@@ -231,6 +241,11 @@ def capture(booster, extra_py: Optional[Dict[str, Any]] = None) -> TrainState:
             "num_valid": len(b.valid_scores),
             "best_iteration": int(getattr(booster, "best_iteration", -1)),
         }
+        ooc = getattr(b, "ooc", None)
+        if ooc is not None:
+            # chunk-schedule identity: a resume streaming a different
+            # grid would change float summation order
+            meta["ooc_schedule"] = ooc.schedule_fingerprint()
         if extra_py:
             py.update(extra_py)
     return TrainState(meta, py, arrays)
@@ -265,6 +280,17 @@ def restore(booster, state: TrainState) -> TrainState:
         raise CheckpointMismatch(
             f"checkpoint has {state.meta['num_valid']} valid sets, "
             f"run registered {len(b.valid_scores)}"
+        )
+    ooc = getattr(b, "ooc", None)
+    want_sched = state.meta.get("ooc_schedule")
+    have_sched = ooc.schedule_fingerprint() if ooc is not None else None
+    if want_sched != have_sched:
+        raise CheckpointMismatch(
+            "checkpoint out-of-core chunk schedule "
+            f"{want_sched!r} != this run's {have_sched!r}; resuming "
+            "with a different streaming grid would change float "
+            "summation order — rerun with the original "
+            "out_of_core/ooc_chunk_rows settings"
         )
     with tracer.span("ckpt.restore", iter=state.iteration):
         b.models = unpack_trees(state.arrays)

@@ -209,7 +209,7 @@ def run_ingest(config: Config, params: Dict[str, str]) -> None:
     tracer.refresh_from_env()
     ds = stream_dataset(config.data, config)
     out = config.data + ".bin"
-    ds.save_binary(out)
+    ds.save_binary(out, source_path=config.data)
     report = dict(getattr(ds, "ingest_report", {}))
     report["output"] = out
     Log.info("Finished ingest: %s", json.dumps(report))

@@ -179,6 +179,19 @@ class Config:
     stream_ingest: str = "auto"
     stream_chunk_rows: int = 0  # 0 = auto-size chunks (~32 MiB raw)
 
+    # --- out-of-core training (boosting/ooc.py; TPU-specific
+    # extension).  out_of_core: 'auto' streams the bin matrix from host
+    # when its packed size exceeds the device budget
+    # (LIGHTGBM_TPU_DEVICE_BUDGET or the backend's reported limit),
+    # 'true'/'false' force; the LIGHTGBM_TPU_OOC env knob overrides.
+    # ooc_chunk_rows: rows per streamed chunk (0 = auto ~64 MiB packed;
+    # always rounded up to the histogram ROW_BLOCK for bit-identity).
+    # ooc_prefetch_depth: in-flight host->device chunk buffers (2 =
+    # double buffering) — this bounds peak device residency.
+    out_of_core: str = "auto"
+    ooc_chunk_rows: int = 0
+    ooc_prefetch_depth: int = 2
+
     # --- tree (TreeConfig, config.h:189–234)
     min_data_in_leaf: int = 20
     min_sum_hessian_in_leaf: float = 1e-3
@@ -314,6 +327,16 @@ class Config:
         if self.bad_row_policy not in ("error", "skip"):
             Log.fatal("bad_row_policy must be 'error' or 'skip', got %s",
                       self.bad_row_policy)
+        if str(self.out_of_core).lower() not in (
+                "auto", "true", "false", "1", "0", "on", "off", "yes", "no"):
+            Log.fatal("out_of_core must be auto/true/false, got %s",
+                      self.out_of_core)
+        if self.ooc_chunk_rows < 0:
+            Log.fatal("ooc_chunk_rows must be >= 0, got %d",
+                      self.ooc_chunk_rows)
+        if self.ooc_prefetch_depth < 1:
+            Log.fatal("ooc_prefetch_depth must be >= 1, got %d",
+                      self.ooc_prefetch_depth)
         if self.network_timeout <= 0:
             Log.fatal("network_timeout must be > 0, got %s", self.network_timeout)
         if self.network_retries < 0:

@@ -12,11 +12,31 @@ half of DatasetLoader, rebuilt for bounded-memory ingest:
               sketch bank, with the cross-host merge
   ``ingest``  two-pass orchestration: Dataset(path) -> packed bin matrix
               without ever materializing the raw float matrix
+  ``cache``   binary-cache format v2: uncompressed npz with a version +
+              source-identity header and per-block CRCs, giving the
+              trainer checksummed random access into the bin matrix
+  ``prefetch`` double-buffered host->device chunk streaming (the
+              out-of-core training pipe) with overlap accounting
 
 See docs/DATA.md for the pipeline contract and memory budget knobs.
 """
 
+from .cache import (  # noqa: F401
+    CACHE_FORMAT_VERSION,
+    CacheReader,
+    build_cache_meta,
+    open_cache_reader,
+    read_cache_meta,
+    stale_reason,
+)
 from .ingest import should_stream, stream_dataset  # noqa: F401
+from .prefetch import (  # noqa: F401
+    ArrayChunkSource,
+    CacheChunkSource,
+    ChunkPlan,
+    ChunkPrefetcher,
+    PrefetchStats,
+)
 from .reader import DenseChunkReader, LibSVMChunkReader, make_reader  # noqa: F401
 from .sketch import CategoricalSketch, GKSketch, NumericSketch  # noqa: F401
 from .stats import SampleCollector, SketchCollector  # noqa: F401
@@ -26,4 +46,8 @@ __all__ = [
     "DenseChunkReader", "LibSVMChunkReader", "make_reader",
     "GKSketch", "NumericSketch", "CategoricalSketch",
     "SampleCollector", "SketchCollector",
+    "CACHE_FORMAT_VERSION", "CacheReader", "build_cache_meta",
+    "open_cache_reader", "read_cache_meta", "stale_reason",
+    "ChunkPlan", "ChunkPrefetcher", "PrefetchStats",
+    "ArrayChunkSource", "CacheChunkSource",
 ]

@@ -81,6 +81,8 @@ def train(
         objective=str(params.get("objective", "")),
         num_leaves=str(params.get("num_leaves", "")),
         num_data=train_set.num_data(),
+        mode="out_of_core" if getattr(booster.boosting, "ooc", None)
+        is not None else "in_memory",
     )
     if init_model is not None:
         _apply_init_model(booster, init_model, train_set)

@@ -105,6 +105,9 @@ class BinnedDataset:
         self.label_idx: int = 0
         self.bundle = None  # EFB BundleInfo (io/bundle.py); None = unbundled
         self.bundled: Optional[np.ndarray] = None  # (N, G) uint8 bundle bins
+        # set when loaded from a v2 binary cache: the out-of-core trainer
+        # streams checksummed chunks straight from this file
+        self.cache_path: Optional[str] = None
         # raw (unbinned) copy is not kept — predictions on training data run
         # on the binned representation like the reference's score updater.
 
@@ -255,10 +258,25 @@ class BinnedDataset:
         return infos
 
     # ------------------------------------------------------------------
-    def save_binary(self, path: str) -> None:
-        """Binary dataset cache (↔ Dataset::SaveBinaryFile)."""
+    def save_binary(self, path: str, source_path: str = None) -> None:
+        """Binary dataset cache (↔ Dataset::SaveBinaryFile), format v2.
+
+        Members are stored UNCOMPRESSED so the bin matrix's bytes are
+        contiguous in the file — the out-of-core trainer seeks straight
+        into them (data/cache.py).  The ``__cache_meta__`` header records
+        the format version, per-block CRCs and — when ``source_path`` is
+        given — the source file's identity, so a cache that no longer
+        matches its source is refused instead of silently trusted."""
+        from ..data.cache import build_cache_meta, chunk_crcs
+
+        meta = build_cache_meta(self.binned, self.metadata.label,
+                                source_path=source_path)
+        import json
+
         payload: Dict[str, np.ndarray] = {
             "magic": np.asarray(_BINARY_MAGIC),
+            "__cache_meta__": np.asarray(json.dumps(meta)),
+            "chunk_crc": chunk_crcs(self.binned),
             "binned": self.binned,
             "used_feature_map": self.used_feature_map,
             "num_total_features": np.asarray(self.num_total_features),
@@ -290,9 +308,11 @@ class BinnedDataset:
             payload[f"m{i}_bounds"] = st["bin_upper_bound"]
             payload[f"m{i}_cats"] = st["bin_2_categorical"]
         # write to the EXACT path (np.savez appends .npz to bare names;
-        # the reference's SaveBinaryFile writes the filename it was given)
+        # the reference's SaveBinaryFile writes the filename it was given).
+        # Uncompressed on purpose: random access into "binned" needs the
+        # raw bytes on disk (and bin matrices barely compress anyway).
         with open(path, "wb") as f:
-            np.savez_compressed(f, **payload)
+            np.savez(f, **payload)
 
     @staticmethod
     def is_binary_cache(path: str) -> bool:
@@ -310,11 +330,43 @@ class BinnedDataset:
 
     @classmethod
     def load_binary(cls, path: str) -> "BinnedDataset":
+        from ..data.cache import (
+            CACHE_FORMAT_VERSION,
+            open_cache_reader,
+            read_cache_meta,
+            stale_reason,
+        )
+
         with np.load(path, allow_pickle=False) as z:
             if str(z["magic"]) != _BINARY_MAGIC:
                 Log.fatal("File %s is not a lightgbm_tpu binary dataset", path)
+            meta = read_cache_meta(z)
+            if meta is None:
+                Log.fatal(
+                    "Binary dataset %s predates cache format v%d (no "
+                    "version/fingerprint header) — regenerate it with "
+                    "task=ingest", path, CACHE_FORMAT_VERSION)
+            if int(meta.get("format_version", 0)) > CACHE_FORMAT_VERSION:
+                Log.fatal(
+                    "Binary dataset %s has cache format v%s, newer than "
+                    "this build supports (v%d)", path,
+                    meta.get("format_version"), CACHE_FORMAT_VERSION)
+            stale = stale_reason(meta)
+            if stale:
+                Log.fatal(
+                    "Refusing stale binary dataset %s: %s — regenerate "
+                    "the cache with task=ingest (or delete it)", path, stale)
             ds = cls()
-            ds.binned = z["binned"]
+            # prefer a read-only memmap of the stored matrix: demand-paged
+            # host residency, and the out-of-core trainer can stream
+            # checksummed chunks straight from the same file
+            reader = open_cache_reader(path)
+            if reader is not None:
+                ds.binned = reader.memmap()
+                ds.cache_path = path
+                reader.close()
+            else:
+                ds.binned = z["binned"]
             ds.used_feature_map = z["used_feature_map"]
             ds.num_total_features = int(z["num_total_features"])
             ds.feature_names = [str(s) for s in z["feature_names"]]

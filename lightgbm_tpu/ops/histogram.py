@@ -71,6 +71,7 @@ def build_histogram(
     select: jnp.ndarray,
     num_bins: int,
     row_block: int = ROW_BLOCK,
+    init: jnp.ndarray = None,
 ) -> jnp.ndarray:
     """Build the (F, B, 3) histogram tensor of (sum_g, sum_h, count).
 
@@ -80,6 +81,11 @@ def build_histogram(
     grad, hess : (N,) f32 gradients/hessians.
     select : (N,) f32 0/1 — leaf-membership (x bagging) mask.
     num_bins : static B — the padded max bin count.
+    init : optional (F, B, 3) carry the block partials fold onto.  Passing
+        the previous chunk's histogram here makes chunked accumulation
+        reproduce the single-pass scan's left-to-right block summation
+        bit-for-bit, as long as every chunk boundary lands on a
+        ``row_block`` multiple (the out-of-core path's contract).
 
     Equivalent to DenseBin::ConstructHistogram (dense_bin.hpp:66) run over
     every feature with the leaf's data indices, without the index
@@ -101,9 +107,31 @@ def build_histogram(
         b_blk, v_blk = xs
         return carry + _hist_one_block(b_blk, v_blk, num_bins), None
 
-    init = jnp.zeros((f, num_bins, 3), dtype=jnp.float32)
+    if init is None:
+        init = jnp.zeros((f, num_bins, 3), dtype=jnp.float32)
     hist, _ = jax.lax.scan(body, init, (bins_b, vals_b))
     return hist
+
+
+def accumulate_histogram(
+    hist: jnp.ndarray,
+    bins: jnp.ndarray,
+    grad: jnp.ndarray,
+    hess: jnp.ndarray,
+    select: jnp.ndarray,
+    num_bins: int,
+    row_block: int = ROW_BLOCK,
+) -> jnp.ndarray:
+    """Chunk-accumulating histogram entry point: fold one row-chunk's
+    block partials onto ``hist`` (the running (F, B, 3) carry).
+
+    Streaming chunks [0, R), [R, 2R), ... through this in ascending order
+    with ``R % row_block == 0`` performs exactly the adds — same values,
+    same order — as one :func:`build_histogram` call over the
+    concatenated rows, which is the out-of-core trainer's bit-identity
+    invariant (only the last chunk may be partial; its padding rows
+    contribute exact zeros, as in the single-pass tail)."""
+    return build_histogram(bins, grad, hess, select, num_bins, row_block, hist)
 
 
 def histogram_from_parent(parent_hist: jnp.ndarray, sibling_hist: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,149 @@
+"""Chunk programs for out-of-core tree growth (boosting/ooc.py).
+
+The mask grower (ops/grow.py) runs one XLA program over the full
+``(N, F)`` bin matrix.  Out-of-core training keeps every *row vector*
+(grad / hess / select / leaf_id / scores) device-resident — they are a
+few N-floats — and streams only the matrix in row-chunks, so these
+programs are the grower's per-split body re-cut at a chunk boundary:
+
+  ``root_hist_chunk``   one chunk's contribution to the root histogram
+  ``split_chunk``       one chunk's share of a split: partition-update
+                        the chunk's ``leaf_id`` slice, count left rows,
+                        and fold BOTH children's histogram partials
+  ``find_best_split``   best split over an accumulated histogram
+  ``child_leaf_values`` the two child leaf outputs at the classic
+                        scalar shapes
+  ``subtract_sibling``  the histogram-subtraction trick
+
+Bit-identity contract (the reason these mirror ``grow_tree`` op for op):
+with chunk boundaries on ``ROW_BLOCK`` multiples, the chunked histogram
+folds perform the identical left-to-right block adds as the in-memory
+scan (see ``accumulate_histogram``); every other per-row op (partition
+predicate, mask multiply, gradient slice) is elementwise or integer, so
+chunking cannot change it.  The only cross-row *float* reduction in tree
+growth is the histogram — "Out-of-Core GPU Gradient Boosting"
+(PAPERS.md) makes the same observation — which is what makes a
+bit-identical streamed replay possible at all.
+
+Donation: the running carries (leaf_id, the two child histograms, the
+left-row count) are donated so per-chunk calls update them in place
+instead of allocating per chunk; the chunk buffer itself is a regular
+argument — the prefetch ring (data/prefetch.py) bounds those to two
+in-flight buffers.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .histogram import ROW_BLOCK, accumulate_histogram
+from .split import NEG_INF, best_split_per_feature, finalize_split, leaf_output
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "row_block"),
+                   donate_argnums=(0,))
+def root_hist_chunk(hist, bins_chunk, grad, hess, select, start,
+                    num_bins: int, row_block: int = ROW_BLOCK):
+    """Fold one chunk into the root histogram.
+
+    ``grad``/``hess``/``select`` are the FULL (N,) device vectors; the
+    chunk's rows are sliced at ``start`` so the per-element products
+    match the in-memory ``build_histogram(bins, grad, hess, select)``
+    exactly."""
+    c = bins_chunk.shape[0]
+    g = jax.lax.dynamic_slice(grad, (start,), (c,))
+    h = jax.lax.dynamic_slice(hess, (start,), (c,))
+    s = jax.lax.dynamic_slice(select, (start,), (c,))
+    return accumulate_histogram(hist, bins_chunk, g, h, s, num_bins, row_block)
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "row_block"),
+                   donate_argnums=(0, 1, 2, 3))
+def split_chunk(leaf_id, hist_l, hist_r, n_left, bins_chunk, grad, hess,
+                select, start, feat, zero_bin, dbz, thr, is_cat, bl, rl,
+                num_bins: int, row_block: int = ROW_BLOCK):
+    """One chunk's share of one split — the streamed counterpart of
+    ``grow_tree._split``'s partition + child-histogram body.
+
+    Updates the chunk's ``leaf_id`` slice by the partition predicate
+    (DataPartition::Split as a predicate on the split feature's bin
+    column), accumulates the left-row count, and folds BOTH children's
+    histogram partials.  Computing both (instead of the in-memory path's
+    smaller-child-only pass) costs extra flops but keeps the streamed
+    split to ONE pass over the matrix — transfers, not flops, bound the
+    out-of-core path.  The caller keeps the direct accumulation for the
+    smaller child and derives the larger via ``subtract_sibling``,
+    exactly like the in-memory grower, so the pooled histograms are
+    bit-identical."""
+    c = bins_chunk.shape[0]
+    lid = jax.lax.dynamic_slice(leaf_id, (start,), (c,))
+    col = jnp.take(bins_chunk, feat, axis=1).astype(jnp.int32)
+    fval = jnp.where(col == zero_bin, dbz, col)
+    goes_left = jnp.where(is_cat, fval == thr, fval <= thr)
+    in_leaf = lid == bl
+    new_lid = jnp.where(in_leaf & ~goes_left, rl, lid)
+    leaf_id = jax.lax.dynamic_update_slice(leaf_id, new_lid, (start,))
+    n_left = n_left + jnp.sum((in_leaf & goes_left).astype(jnp.int32))
+
+    g = jax.lax.dynamic_slice(grad, (start,), (c,))
+    h = jax.lax.dynamic_slice(hess, (start,), (c,))
+    s = jax.lax.dynamic_slice(select, (start,), (c,))
+    sel_l = s * (new_lid == bl).astype(s.dtype)
+    sel_r = s * (new_lid == rl).astype(s.dtype)
+    hist_l = accumulate_histogram(hist_l, bins_chunk, g, h, sel_l,
+                                  num_bins, row_block)
+    hist_r = accumulate_histogram(hist_r, bins_chunk, g, h, sel_r,
+                                  num_bins, row_block)
+    return leaf_id, hist_l, hist_r, n_left
+
+
+@jax.jit
+def root_totals(grad, hess, select):
+    """Root leaf sums — the same full-N reductions as ``grow_tree``'s
+    ``LeafSplits::Init`` (the N-vectors stay device-resident out of
+    core, so these are not chunked)."""
+    tg = jnp.sum(grad * select)
+    th = jnp.sum(hess * select)
+    tc = jnp.sum(select)
+    return jnp.stack([tg, th, tc])
+
+
+@functools.partial(jax.jit, static_argnames=("use_missing",))
+def find_best_split(hist, sums, feature_mask, depth_ok, meta, hyper,
+                    use_missing: bool = True):
+    """Best split over an accumulated (F, B, 3) histogram — the serial
+    branch of ``grow_tree.find_best`` verbatim."""
+    sg, sh, sc = sums[0], sums[1], sums[2]
+    gain_f, thr_f, dbz_f, left_f = best_split_per_feature(
+        hist, sg, sh, sc, meta, hyper, feature_mask, use_missing
+    )
+    res = finalize_split(gain_f, thr_f, dbz_f, left_f, sg, sh, sc, hyper)
+    return res._replace(gain=jnp.where(depth_ok, res.gain, NEG_INF))
+
+
+@jax.jit
+def child_leaf_values(left, right, l1, l2):
+    """The two child outputs at the classic scalar shapes
+    (CalculateSplittedLeafOutput on (sum_g, sum_h) scalars)."""
+    lval = leaf_output(left[0], left[1], l1, l2)
+    rval = leaf_output(right[0], right[1], l1, l2)
+    return lval, rval
+
+
+@jax.jit
+def subtract_sibling(parent_hist, smaller_hist):
+    """FeatureHistogram::Subtract — one tensor subtract."""
+    return parent_hist - smaller_hist
+
+
+@jax.jit
+def scatter_add_slice(vec, delta, start):
+    """``vec[start : start+len(delta)] += delta`` — used by the streamed
+    ``predict_binned`` fallback (rollback/DART keep working when the
+    matrix is not device-resident)."""
+    c = delta.shape[0]
+    cur = jax.lax.dynamic_slice(vec, (start,), (c,))
+    return jax.lax.dynamic_update_slice(vec, cur + delta, (start,))

@@ -509,6 +509,16 @@ def grow_tree_partitioned(
         p, seg2, bs2, leaf2, ps2 = jax.lax.cond(
             has_pre, take_pre, take_classic, st.p
         )
+        # child outputs are recomputed HERE, at one shared (2,)-shaped
+        # site outside the cond, from the children's g/h sums.  The
+        # level-batched precompute evaluates leaf_output over (SMAX, 2)
+        # candidate batches; routing both branches through the SAME
+        # division op removes batch-shape / fusion-context rounding as a
+        # variable between the LEVELGROW modes, so accepted leaf values
+        # depend only on the (psum-exact) integer-scaled g/h sums.
+        leaf2 = leaf2.at[:, 3].set(
+            leaf_output(leaf2[:, 0], leaf2[:, 1],
+                        hyper.lambda_l1, hyper.lambda_l2))
         idx2 = jnp.stack([bl, rl])
         rec = jnp.stack(
             [bl.astype(jnp.float32), feat.astype(jnp.float32),
@@ -576,15 +586,28 @@ def level_hists(p, seg_tab, n_active, params: PGrowParams, rows=None,
 def segment_values(tree: PTreeResult, num_rows: int, values: jnp.ndarray) -> jnp.ndarray:
     """(N,) vector assigning ``values[leaf]`` to each position of that
     leaf's segment — the partitioned-space replacement for
-    leaf_id-indexed lookups.  Scatter- and sort-free range-add: +v at
-    each segment start, -v at each segment end, then one cumsum."""
+    leaf_id-indexed lookups.
+
+    The lookup must be EXACT, not merely close: a float range-add
+    (+v at starts, -v at ends, cumsum) leaves position-dependent 1-ULP
+    residue inside segments because XLA's cumsum is a parallel prefix
+    sum whose reassociation differs per position — and the physical
+    order of rows inside a segment is NOT layout-stable (the level
+    grower's speculative partitions shuffle it), so that residue made
+    training scores depend on partition history.  Instead: an integer
+    cumsum over segment-start marks (exact) ranks each position's
+    covering segment, and the value is gathered — every row of a leaf
+    gets the bit-identical ``values[leaf]``."""
     L = tree.starts.shape[0]
     active = jnp.arange(L) <= tree.num_splits
     v = jnp.where(active, values, 0.0)
-    s = jnp.where(active, tree.starts, num_rows)
-    e = jnp.where(active, tree.starts + tree.cnts, num_rows)
-    line = jnp.zeros((num_rows + 1,), jnp.float32).at[s].add(v).at[e].add(-v)
-    return jnp.cumsum(line)[:num_rows]
+    # empty segments share their start with a neighbour: park them (and
+    # inactive slots) past the end so they never win the rank lookup
+    s = jnp.where(active & (tree.cnts > 0), tree.starts, num_rows)
+    marks = jnp.zeros((num_rows + 1,), jnp.int32).at[s].add(1)
+    rank = jnp.cumsum(marks)[:num_rows] - 1
+    order = jnp.argsort(s)  # segment slots in physical start order
+    return jnp.take(v, jnp.take(order, jnp.clip(rank, 0, L - 1)))
 
 
 def split_audit_rows(gr):

@@ -1,16 +1,19 @@
 """Split-decision audit-trail tests (obs/audit.py + ``report diff``).
 
 The acceptance contract: audit trails from a LEVELGROW=0 and a
-LEVELGROW=1 run of the same config are BYTE-identical at a known-parity
-config, and at the known-divergent config (ROADMAP item 1: 15 leaves /
-min_data_in_leaf=20 / 6 rounds) ``report diff`` localizes the first
-divergent decision — turning "the models differ" into a pinned minimal
-repro.  What the diff pins at that config: every split decision
-(feature / bin threshold / gain) MATCHES across the two modes, and the
-first divergence is ONE leaf value of iteration 2's tree differing by
-1 ULP — the level-batched selection replay rounds a leaf value
-differently, it does not pick different splits.  The parity assertion
-itself is marked xfail(strict=True) so a future fix flips it loudly.
+LEVELGROW=1 run of the same config are BYTE-identical — both at the
+original known-parity config and at the formerly-divergent one (ROADMAP
+item 1: 15 leaves / min_data_in_leaf=20 / 6 rounds).  That config used
+to diverge by ONE leaf value of iteration 2's tree (1 ULP).  Root
+cause: the two modes leave different physical row orders behind (the
+level grower speculatively partitions candidate levels best-first
+acceptance never takes), and ``segment_values``' float range-add
+cumsum carried position-dependent 1-ULP residue — so training scores,
+and from round 2 on the gradients, depended on partition history.
+Fixed by an exact integer-rank gather in ``segment_values`` plus a
+canonical row order at every tree start, so the repro class asserts
+parity; ``report diff`` localization is covered on synthetic trails in
+TestReportDiff.
 """
 
 import json
@@ -123,8 +126,16 @@ class TestAuditStream:
 
 
 class TestLevelgrowDivergenceRepro:
-    """The pinned repro for the open LEVELGROW=1 vs =0 divergence
-    (ROADMAP item 1)."""
+    """The formerly-divergent LEVELGROW=1 vs =0 config (ROADMAP item 1).
+
+    The two modes leave different within-segment row orders (the level
+    grower partitions speculative candidates), and the old
+    ``segment_values`` float-cumsum range-add gave different rows
+    1-ULP-different score deltas depending on position — so from round
+    2 on, gradients (hence one leaf value of tree 2) diverged.  Fixed
+    by the exact integer-rank ``segment_values`` gather plus canonical
+    row order at each tree start; this class pins the parity (the
+    synthetic-trail localization coverage lives in TestReportDiff)."""
 
     @pytest.fixture(scope="class")
     def trails(self, tmp_path_factory):
@@ -137,44 +148,17 @@ class TestLevelgrowDivergenceRepro:
             mp.undo()
         return p0, m0, p1, m1
 
-    @pytest.mark.xfail(
-        strict=True,
-        reason="open LEVELGROW=1 vs =0 divergence (ROADMAP item 1): the "
-               "level-batched replay rounds one leaf value of iteration "
-               "2 differently by 1 ULP at 15 leaves/min_data_in_leaf=20/"
-               "6 rounds; strict so a fix flips this loudly")
     def test_levelgrow_models_match_at_divergent_config(self, trails):
         p0, m0, p1, m1 = trails
         assert m0 == m1
 
-    def test_diff_localizes_first_divergent_decision(self, trails,
-                                                     capsys):
-        """``report diff`` must pin the divergence to a single record
-        with iteration context — the minimal repro the ISSUE asks for —
-        and every split DECISION before it must match (the divergence
-        is a leaf-value rounding, not a different split)."""
+    def test_trails_byte_identical_at_divergent_config(self, trails):
+        """Beyond the model string: the full audit trails (every split
+        decision, every leaf value) must be byte-identical, and
+        ``report diff`` must agree."""
         p0, m0, p1, m1 = trails
-        assert m0 != m1, "divergent config unexpectedly reached parity " \
-            "(if a fix landed, flip the xfail above and retire this)"
+        with open(p0, "rb") as a, open(p1, "rb") as b:
+            assert a.read() == b.read()
         from lightgbm_tpu.cli import main
-        from lightgbm_tpu.obs import report
 
-        rc = main(["report", "diff", p0, p1, "--json"])
-        out = capsys.readouterr().out
-        assert rc == 1
-        div = json.loads(out)
-        assert div["identical"] is False
-        assert div["a"]["ev"] in ("split", "tree")
-        assert "it" in div["a"] and div["fields"]
-        # localization value: no split decision diverges before the
-        # first divergent record — feature/threshold/gain all match
-        a = report.load_trace(p0, warn=False)
-        b = report.load_trace(p1, warn=False)
-        for ra, rb in zip(a[: div["index"]], b[: div["index"]]):
-            assert ra == rb
-        # human rendering names the iteration and the differing field
-        rc = main(["report", "diff", p0, p1])
-        out = capsys.readouterr().out
-        assert rc == 1
-        assert f"record {div['index']}" in out
-        assert f"it={div['a']['it']}" in out
+        assert main(["report", "diff", p0, p1]) == 0

@@ -96,3 +96,49 @@ def test_raw_bench_format_accepted(tmp_path):
     out = {"metric": METRIC, "value": 0.2}
     assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 1
     assert out["regression"] is True
+
+
+def _ooc(rows=200_000, chunk_rows=65_536, s_per_iter=1.0):
+    return {"rows": rows, "chunk_rows": chunk_rows,
+            "stream_s_per_iter": s_per_iter}
+
+
+def test_ooc_gate_fires_on_slow_stream(tmp_path):
+    """The streamed s/iter gates independently of the headline metric —
+    an OOC regression with a healthy fused number still fails."""
+    _capture(tmp_path, "BENCH_r01.json", 0.10, out_of_core=_ooc(s_per_iter=1.0))
+    out = {"metric": METRIC, "value": 0.10,  # headline: fine
+           "out_of_core": _ooc(s_per_iter=1.2)}  # stream: 20% slower
+    rc = bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={})
+    assert rc == 1
+    assert out.get("regression_ooc") is True
+    assert "regression" not in out
+    assert out["gate_ooc"]["best_prior_stream_s_per_iter"] == 1.0
+
+
+def test_ooc_gate_requires_same_grid(tmp_path):
+    # a prior at a different chunk grid is a different summation/stream
+    # schedule: not comparable
+    _capture(tmp_path, "BENCH_r01.json", 0.10,
+             out_of_core=_ooc(chunk_rows=4096, s_per_iter=0.5))
+    out = {"metric": METRIC, "value": 0.10, "out_of_core": _ooc(s_per_iter=9.9)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+    assert "gate_ooc" not in out and "regression_ooc" not in out
+
+
+def test_ooc_gate_runs_without_headline_prior(tmp_path):
+    # first capture of a new main config, but the ooc grid has history
+    _capture(tmp_path, "BENCH_r01.json", 0.10, out_of_core=_ooc(s_per_iter=1.0),
+             metric="sec/iteration (binary, 120000x28, max_bin=63, num_leaves=255)")
+    out = {"metric": METRIC, "value": 0.10, "out_of_core": _ooc(s_per_iter=1.2)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 1
+    assert out.get("regression_ooc") is True
+    assert "gate" not in out  # headline leg silently skipped
+
+
+def test_ooc_section_error_never_gates(tmp_path):
+    _capture(tmp_path, "BENCH_r01.json", 0.10, out_of_core=_ooc(s_per_iter=1.0))
+    out = {"metric": METRIC, "value": 0.10,
+           "out_of_core": {"error": "RuntimeError: boom"}}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+    assert "gate_ooc" not in out

@@ -1,0 +1,482 @@
+"""Out-of-core training tests (boosting/ooc.py, data/prefetch.py,
+data/cache.py — docs/DATA.md "Out-of-core training").
+
+The acceptance contract: with ``chunk_rows`` a ``ROW_BLOCK`` multiple
+(the trainer rounds up), streamed training is **byte-identical** to the
+in-memory model at any scale where the in-memory grower uses the masked
+full scan (``N <= TIER_MIN``) — for gbdt and GOSS, across chunk-boundary
+edge cases, and through a mid-run kill/resume.  The v2 binary cache
+must refuse stale/foreign/corrupt bytes instead of training them.
+"""
+
+import json
+import os
+import struct
+import subprocess
+import sys
+import zipfile
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.data.cache import (
+    CACHE_FORMAT_VERSION,
+    CacheReader,
+    build_cache_meta,
+    chunk_crcs,
+    open_cache_reader,
+    stale_reason,
+)
+from lightgbm_tpu.data.prefetch import (
+    ArrayChunkSource,
+    ChunkPlan,
+    ChunkPrefetcher,
+    PrefetchStats,
+)
+from lightgbm_tpu.ops.histogram import ROW_BLOCK
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+          "min_data_in_leaf": 20}
+
+
+@pytest.fixture(scope="module")
+def xy():
+    rng = np.random.RandomState(3)
+    X = rng.randn(2500, 10)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] + 0.2 * rng.randn(2500) > 0)
+    return X, y.astype(float)
+
+
+def _train(X, y, extra=None, rounds=6, **kw):
+    P = dict(PARAMS)
+    if extra:
+        P.update(extra)
+    bst = lgb.train(dict(P), lgb.Dataset(X, label=y, params=dict(P)),
+                    num_boost_round=rounds, verbose_eval=False, **kw)
+    return bst
+
+
+# ======================================================================
+# chunk plan / prefetch ring units
+# ======================================================================
+class TestChunkPlan:
+    def test_bounds_tile_the_rows(self):
+        plan = ChunkPlan(10_000, 4096)
+        assert plan.bounds == [(0, 4096), (4096, 8192), (8192, 10_000)]
+        assert plan.num_chunks == 3
+
+    def test_single_chunk_when_rows_fit(self):
+        plan = ChunkPlan(100, 4096)
+        assert plan.bounds == [(0, 100)]
+
+    def test_fingerprint_pins_the_grid(self):
+        a, b = ChunkPlan(10_000, 4096), ChunkPlan(10_000, 8192)
+        assert a.fingerprint() != b.fingerprint()
+        assert a.fingerprint() == ChunkPlan(10_000, 4096).fingerprint()
+
+    def test_rejects_nonpositive_chunk(self):
+        with pytest.raises(ValueError):
+            ChunkPlan(100, 0)
+
+    def test_chunk_rows_rounds_up_to_row_block(self):
+        from lightgbm_tpu.boosting.ooc import resolve_chunk_rows
+
+        class C:
+            ooc_chunk_rows = 1
+
+        # a 1-row request degenerates to one ROW_BLOCK, never to a
+        # shorter (different-summation-order) block
+        assert resolve_chunk_rows(C(), 10, 1) == ROW_BLOCK
+        C.ooc_chunk_rows = ROW_BLOCK + 1
+        assert resolve_chunk_rows(C(), 10, 1) == 2 * ROW_BLOCK
+
+
+class TestPrefetcher:
+    def test_streams_every_chunk_in_order(self):
+        binned = np.arange(5000 * 3, dtype=np.uint8).reshape(5000, 3)
+        plan = ChunkPlan(5000, 1024)
+        stats = PrefetchStats()
+        pf = ChunkPrefetcher(ArrayChunkSource(binned), plan, 2, stats)
+        seen = []
+        for i, start, stop, dev in pf.stream():
+            assert np.array_equal(np.asarray(dev), binned[start:stop])
+            seen.append((i, start, stop))
+        assert seen == [(i, s, e) for i, (s, e) in enumerate(plan.bounds)]
+        assert stats.chunks == plan.num_chunks
+        assert stats.bytes == binned.nbytes
+        assert stats.passes == 1
+
+    def test_ring_is_bounded_by_depth(self):
+        binned = np.zeros((20_000, 4), np.uint8)
+        plan = ChunkPlan(20_000, 1024)
+        stats = PrefetchStats()
+        pf = ChunkPrefetcher(ArrayChunkSource(binned), plan, 2, stats)
+        import time
+
+        for _ in pf.stream():
+            time.sleep(0.002)  # slow consumer: the producer must block
+        # depth-1 queued + the producer's in-hand chunk
+        assert stats.peak_inflight <= 2
+
+    def test_depth_must_be_positive(self):
+        with pytest.raises(ValueError):
+            ChunkPrefetcher(ArrayChunkSource(np.zeros((8, 2), np.uint8)),
+                            ChunkPlan(8, 4), depth=0)
+
+    def test_producer_error_surfaces_in_consumer(self):
+        class Bad:
+            num_rows, num_cols, dtype = 100, 2, np.dtype(np.uint8)
+
+            def read(self, start, stop):
+                raise IOError("disk gone")
+
+            def describe(self):
+                return "bad"
+
+        pf = ChunkPrefetcher(Bad(), ChunkPlan(100, 64), 2)
+        with pytest.raises(IOError, match="disk gone"):
+            list(pf.stream())
+
+    def test_overlap_pct_bounds(self):
+        s = PrefetchStats()
+        assert s.overlap_pct() == 100.0  # nothing fetched yet
+        s.fetch_s, s.stall_s = 1.0, 0.25
+        assert s.overlap_pct() == 75.0
+        s.stall_s = 5.0
+        assert s.overlap_pct() == 0.0
+
+
+# ======================================================================
+# v2 binary cache: round trip, random access, integrity refusals
+# ======================================================================
+class TestCacheV2:
+    @pytest.fixture()
+    def cache(self, tmp_path, xy):
+        X, y = xy
+        path = str(tmp_path / "train.bin")
+        ds = lgb.Dataset(X, label=y, params=dict(PARAMS))
+        ds.construct(dict(PARAMS)).save_binary(path)
+        return path
+
+    def test_reader_random_access_matches_memmap(self, cache):
+        with CacheReader(cache) as r:
+            mm = r.memmap()
+            assert int(r.meta["format_version"]) == CACHE_FORMAT_VERSION
+            for lo, hi in ((0, 5), (100, 612), (2400, 2500)):
+                assert np.array_equal(r.read_rows(lo, hi), mm[lo:hi])
+            r.verify_all()
+
+    def test_loaded_dataset_streams_from_the_cache(self, cache, xy):
+        X, y = xy
+        ds = lgb.Dataset(cache, params=dict(PARAMS))
+        built = ds.construct(dict(PARAMS))
+        assert built.cache_path == cache
+        assert isinstance(built.binned, np.memmap)
+
+    def test_corrupt_block_refused_with_block_address(self, cache):
+        r = CacheReader(cache)
+        off = r.data_offset  # first byte of row 0
+        r.close()
+        with open(cache, "r+b") as f:
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0xFF]))
+        with CacheReader(cache) as r:
+            with pytest.raises(IOError, match="CRC mismatch.*block 0"):
+                r.read_rows(0, r.num_rows)
+
+    def test_v1_cache_without_header_is_refused(self, tmp_path, cache):
+        # strip the v2 header members -> the PR-3 format
+        v1 = str(tmp_path / "v1.bin")
+        with zipfile.ZipFile(cache) as zin, \
+                zipfile.ZipFile(v1, "w", zipfile.ZIP_STORED) as zout:
+            for info in zin.infolist():
+                if info.filename in ("__cache_meta__.npy", "chunk_crc.npy"):
+                    continue
+                zout.writestr(info, zin.read(info.filename))
+        with pytest.raises(lgb.LightGBMError, match="predates cache"):
+            lgb.Dataset(v1, params=dict(PARAMS)).construct(dict(PARAMS))
+
+    def test_newer_format_version_is_refused(self, tmp_path, cache):
+        newer = str(tmp_path / "newer.bin")
+        with zipfile.ZipFile(cache) as zin, \
+                zipfile.ZipFile(newer, "w", zipfile.ZIP_STORED) as zout:
+            for info in zin.infolist():
+                data = zin.read(info.filename)
+                if info.filename == "__cache_meta__.npy":
+                    import io as _io
+
+                    meta = json.loads(str(np.lib.format.read_array(
+                        _io.BytesIO(data))))
+                    meta["format_version"] = CACHE_FORMAT_VERSION + 1
+                    buf = _io.BytesIO()
+                    np.lib.format.write_array(buf, np.asarray(
+                        json.dumps(meta)))
+                    data = buf.getvalue()
+                zout.writestr(info, data)
+        with pytest.raises(lgb.LightGBMError, match="newer than"):
+            lgb.Dataset(newer, params=dict(PARAMS)).construct(dict(PARAMS))
+
+    def test_stale_source_is_refused(self, tmp_path):
+        src = tmp_path / "src.csv"
+        src.write_text("1,2\n")
+        meta = build_cache_meta(np.zeros((8, 2), np.uint8), None,
+                                source_path=str(src))
+        assert stale_reason(meta) is None
+        src.write_text("1,2,3\n")  # regenerate the source
+        assert "size changed" in stale_reason(meta)
+
+    def test_crc_blocks_align_with_row_block(self):
+        from lightgbm_tpu.data.cache import CRC_ROWS
+
+        assert CRC_ROWS == ROW_BLOCK
+        crcs = chunk_crcs(np.arange(2 * ROW_BLOCK + 5,
+                                    dtype=np.uint8).reshape(-1, 1))
+        assert crcs.shape == (3,)
+
+
+# ======================================================================
+# streamed-vs-resident parity (the bit-identity acceptance gate)
+# ======================================================================
+class TestOocParity:
+    def test_gbdt_byte_identical(self, xy):
+        X, y = xy
+        m_mem = _train(X, y).model_to_string()
+        m_ooc = _train(X, y, {"out_of_core": "true",
+                              "ooc_chunk_rows": 1024}).model_to_string()
+        assert m_ooc == m_mem
+
+    @pytest.mark.parametrize("chunk_rows", [1, 1000, 2048, 2500, 9999])
+    def test_chunk_boundary_cases(self, xy, chunk_rows):
+        """Rounding-up-to-ROW_BLOCK (1), a last partial chunk (1000,
+        2048), chunk == nrows and chunk > nrows (single-chunk stream)
+        all reproduce the same bytes."""
+        X, y = xy
+        m_mem = _train(X, y, rounds=3).model_to_string()
+        m = _train(X, y, {"out_of_core": "true",
+                          "ooc_chunk_rows": chunk_rows},
+                   rounds=3).model_to_string()
+        assert m == m_mem
+
+    def test_goss_byte_identical(self, xy):
+        """GOSS's top-k is over the resident gradient vectors, so the
+        top set is global across chunks by construction."""
+        X, y = xy
+        g = {"boosting": "goss"}
+        m_mem = _train(X, y, g).model_to_string()
+        m_ooc = _train(X, y, {**g, "out_of_core": "true",
+                              "ooc_chunk_rows": 1024}).model_to_string()
+        assert m_ooc == m_mem
+
+    def test_train_from_binary_cache_streams_checksummed(self, tmp_path, xy):
+        """ingest -> cache -> train: the OOC trainer streams straight
+        from the v2 cache (CacheChunkSource) and still reproduces the
+        in-memory bytes."""
+        X, y = xy
+        path = str(tmp_path / "train.bin")
+        lgb.Dataset(X, label=y, params=dict(PARAMS)).construct(
+            dict(PARAMS)).save_binary(path)
+        P = dict(PARAMS, out_of_core="true", ooc_chunk_rows=1024)
+        bst = lgb.train(dict(P), lgb.Dataset(path, params=dict(P)),
+                        num_boost_round=4, verbose_eval=False)
+        ooc = bst.boosting.ooc
+        assert ooc is not None
+        assert "cache(" in ooc.source.describe()
+        m_mem = _train(X, y, rounds=4).model_to_string()
+        assert bst.model_to_string() == m_mem
+
+    def test_predictions_match_too(self, xy):
+        X, y = xy
+        b_mem = _train(X, y, rounds=4)
+        b_ooc = _train(X, y, {"out_of_core": "true",
+                              "ooc_chunk_rows": 1024}, rounds=4)
+        np.testing.assert_array_equal(b_mem.predict(X), b_ooc.predict(X))
+
+
+# ======================================================================
+# routing decision
+# ======================================================================
+class TestOocRouting:
+    def test_off_by_default_without_budget_pressure(self, xy, monkeypatch):
+        monkeypatch.delenv("LIGHTGBM_TPU_OOC", raising=False)
+        monkeypatch.setenv("LIGHTGBM_TPU_DEVICE_BUDGET", str(1 << 40))
+        X, y = xy
+        assert _train(X, y, rounds=1).boosting.ooc is None
+
+    def test_auto_engages_past_device_budget(self, xy, monkeypatch):
+        monkeypatch.delenv("LIGHTGBM_TPU_OOC", raising=False)
+        monkeypatch.setenv("LIGHTGBM_TPU_DEVICE_BUDGET", "1024")
+        X, y = xy
+        bst = _train(X, y, rounds=1)
+        assert bst.boosting.ooc is not None
+
+    def test_env_var_overrides_config(self, xy, monkeypatch):
+        monkeypatch.setenv("LIGHTGBM_TPU_OOC", "false")
+        X, y = xy
+        bst = _train(X, y, {"out_of_core": "true"}, rounds=1)
+        assert bst.boosting.ooc is None
+
+    def test_unknown_mode_is_refused(self, xy):
+        X, y = xy
+        with pytest.raises(lgb.LightGBMError, match="out_of_core"):
+            _train(X, y, {"out_of_core": "sideways"}, rounds=1)
+
+    def test_dart_forced_is_refused(self, xy):
+        """DART mutates past trees (its score rebuild assumes resident
+        bins): an explicit out_of_core=true it cannot honour is an
+        error, never a silent downgrade."""
+        X, y = xy
+        with pytest.raises(lgb.LightGBMError, match="not supported"):
+            _train(X, y, {"boosting": "dart", "out_of_core": "true"},
+                   rounds=1)
+
+    def test_dart_auto_falls_back_to_memory(self, xy, monkeypatch):
+        """Auto-routing (budget pressure, nothing forced) downgrades to
+        in-memory with a warning instead of crashing."""
+        monkeypatch.delenv("LIGHTGBM_TPU_OOC", raising=False)
+        monkeypatch.setenv("LIGHTGBM_TPU_DEVICE_BUDGET", "1024")
+        X, y = xy
+        bst = _train(X, y, {"boosting": "dart"}, rounds=1)
+        assert bst.boosting.ooc is None
+
+
+# ======================================================================
+# checkpoint/resume under streaming
+# ======================================================================
+class TestOocCkpt:
+    OOC = {"out_of_core": "true", "ooc_chunk_rows": 1024}
+
+    def _train_ckpt(self, X, y, rounds, ckpt_dir, extra=None, callbacks=None):
+        from lightgbm_tpu.ckpt import CheckpointManager
+
+        P = dict(PARAMS, **self.OOC)
+        if extra:
+            P.update(extra)
+        mgr = CheckpointManager(ckpt_dir, freq=2)
+        try:
+            return lgb.train(dict(P), lgb.Dataset(X, label=y,
+                                                  params=dict(P)),
+                             rounds, verbose_eval=False,
+                             checkpoint_manager=mgr, callbacks=callbacks)
+        finally:
+            mgr.close()
+
+    def test_kill_resume_byte_identical(self, tmp_path, xy):
+        X, y = xy
+        d_ref = str(tmp_path / "ref")
+        d_kill = str(tmp_path / "kill")
+        m_ref = self._train_ckpt(X, y, 6, d_ref).model_to_string()
+
+        def kill(env):
+            if env.iteration + 1 == 4:
+                raise KeyboardInterrupt
+        kill.order = 99
+        with pytest.raises(KeyboardInterrupt):
+            self._train_ckpt(X, y, 6, d_kill, callbacks=[kill])
+        m_res = self._train_ckpt(X, y, 6, d_kill).model_to_string()
+        assert m_res == m_ref
+
+    def test_resume_with_different_grid_is_refused(self, tmp_path, xy):
+        from lightgbm_tpu.ckpt import CheckpointMismatch
+
+        X, y = xy
+        d = str(tmp_path / "grid")
+
+        def kill(env):
+            if env.iteration + 1 == 4:
+                raise KeyboardInterrupt
+        kill.order = 99
+        with pytest.raises(KeyboardInterrupt):
+            self._train_ckpt(X, y, 6, d, callbacks=[kill])
+        # the config fingerprint (which covers ooc_chunk_rows) refuses
+        # first; the meta["ooc_schedule"] check backstops auto-resolved
+        # grids that shift without a config change
+        with pytest.raises(CheckpointMismatch,
+                           match="chunk schedule|different training config"):
+            self._train_ckpt(X, y, 6, d, extra={"ooc_chunk_rows": 8192})
+
+    def test_schedule_backstop_refuses_shifted_grid(self, xy):
+        """The meta["ooc_schedule"] check itself: an auto-resolved grid
+        that shifts without any config change (e.g. a different device
+        budget on the resuming host) must refuse, not resume into a
+        different float summation order."""
+        from lightgbm_tpu.ckpt import CheckpointMismatch, capture, restore
+
+        X, y = xy
+        P = dict(PARAMS, **self.OOC)
+        bst = lgb.train(dict(P), lgb.Dataset(X, label=y, params=dict(P)),
+                        2, verbose_eval=False)
+        st = capture(bst)
+        assert st.meta["ooc_schedule"] == \
+            bst.boosting.ooc.schedule_fingerprint()
+        st.meta["ooc_schedule"] = "999r/512c/2"
+        with pytest.raises(CheckpointMismatch, match="chunk schedule"):
+            restore(bst, st)
+
+
+# ======================================================================
+# residency smoke (tier-1) + the at-scale leg (slow)
+# ======================================================================
+@pytest.mark.ooc
+class TestResidency:
+    def test_stream_accounting_bounds_residency(self, xy):
+        """Peak in-flight chunks never exceed the ring depth — the
+        O(2 chunks) device-residency contract — and every grow pass
+        streams the full grid exactly once."""
+        X, y = xy
+        bst = _train(X, y, {"out_of_core": "true", "ooc_chunk_rows": 1024},
+                     rounds=3)
+        ooc = bst.boosting.ooc
+        assert ooc is not None
+        st = ooc.stats
+        assert st.peak_inflight <= ooc.depth
+        assert st.chunks == st.passes * ooc.plan.num_chunks
+        assert st.bytes > 0
+
+
+_RSS_CHILD = r"""
+import os, resource, sys
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import lightgbm_tpu as lgb
+
+n, f = 400_000, 40
+rng = np.random.RandomState(0)
+# column-wise generation: never materialize the float matrix twice
+X = np.empty((n, f), np.float32)
+for j in range(f):
+    X[:, j] = rng.randn(n).astype(np.float32)
+y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+P = {"objective": "binary", "num_leaves": 31, "verbose": -1,
+     "out_of_core": "true", "ooc_chunk_rows": 65536}
+path = sys.argv[1]
+ds = lgb.Dataset(X, label=y, params=dict(P))
+ds.construct(dict(P)).save_binary(path)
+del ds, X
+bst = lgb.train(dict(P), lgb.Dataset(path, label=y, params=dict(P)),
+                num_boost_round=3, verbose_eval=False)
+assert bst.boosting.ooc is not None
+st = bst.boosting.ooc.stats
+print("RSS_MB", resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024)
+print("CHUNKS", st.chunks, "PEAK", st.peak_inflight)
+"""
+
+
+@pytest.mark.ooc
+@pytest.mark.slow
+def test_large_stream_subprocess(tmp_path):
+    """The at-scale leg: 400k x 40 from a binary cache, streamed in
+    64k-row chunks.  Asserts the run completes, streams the whole grid
+    each pass, and keeps the bounded ring."""
+    out = subprocess.run(
+        [sys.executable, "-c", _RSS_CHILD, str(tmp_path / "big.bin")],
+        capture_output=True, text=True, timeout=1800,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = dict(l.split(" ", 1) for l in out.stdout.strip().splitlines()
+                 if " " in l)
+    assert int(lines["CHUNKS"].split()[0]) > 0
+    assert int(lines["CHUNKS"].split()[2]) <= 2
