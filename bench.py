@@ -122,6 +122,50 @@ def apply_regression_gate(out: dict, bench_dir: str = None, env=None) -> int:
             if float(val) > thr_o:
                 out["regression_ooc"] = True
                 rc = 1
+    # serving-swap leg (independent): a hot swap to a same-shape retrain
+    # must compile NOTHING (the tree-shape-bucket contract) — any
+    # swap_new_compiles is a regression outright, no prior needed.  Swap
+    # latency p99 gates against priors with the same swap count, at a
+    # wider 1.5x threshold: the op is short host work (load + cache-hit
+    # warmup), so its relative run-to-run variance dwarfs the s/iter legs'
+    sw = (out.get("serving") or {}).get("swap") or {}
+    if not sw.get("error"):
+        if isinstance(sw.get("swap_new_compiles"), int) and \
+                sw["swap_new_compiles"] > 0:
+            out["regression_swap_compiles"] = True
+            rc = 1
+        val_s = sw.get("swap_latency_p99_ms")
+        if isinstance(val_s, (int, float)) and val_s > 0:
+            best_s, src_s = None, None
+            for path in sorted(glob.glob(os.path.join(bench_dir,
+                                                      "BENCH_r*.json"))):
+                try:
+                    with open(path) as f:
+                        doc = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                parsed = doc.get("parsed") if isinstance(doc, dict) else None
+                if not isinstance(parsed, dict):
+                    parsed = doc if isinstance(doc, dict) else {}
+                if parsed.get("backend_fallback"):
+                    continue
+                ps = (parsed.get("serving") or {}).get("swap") or {}
+                pv = ps.get("swap_latency_p99_ms")
+                if ps.get("swaps") != sw.get("swaps"):
+                    continue
+                if isinstance(pv, (int, float)) and pv > 0 and (
+                        best_s is None or pv < best_s):
+                    best_s, src_s = float(pv), os.path.basename(path)
+            if best_s is not None:
+                thr_s = best_s * 1.5
+                out["gate_swap"] = {
+                    "best_prior_swap_p99_ms": round(best_s, 3),
+                    "best_prior_source": src_s,
+                    "threshold_ms": round(thr_s, 3),
+                }
+                if float(val_s) > thr_s:
+                    out["regression_swap"] = True
+                    rc = 1
     return rc
 
 
@@ -195,7 +239,46 @@ def _bench_serving(booster, X, batch_sizes=(1, 128, 2048), reps=20):
                 "rows_per_s": round(bs / p50, 1),
             }
         section["measure_new_compiles"] = compilewatch.total_compiles() - c1
+        section["swap"] = _bench_swap(packed, max_bucket)
     except Exception as e:  # pragma: no cover — serving must not kill bench
+        section["error"] = f"{type(e).__name__}: {e}"
+    return section
+
+
+def _bench_swap(packed, warmup_rows, n_swaps=5):
+    """Hot-swap cost (serve/fleet.py): swap a warmed SwappablePredictor
+    to a sequence of same-shape "retrains" (leaf values perturbed, tree
+    shapes unchanged) and report swap latency p50/p99 plus the XLA
+    compiles the swaps cost.  The tree-shape compile-cache buckets make
+    the contract swap_new_compiles == 0 — the regression gate fails the
+    run on any violation (apply_regression_gate, serving-swap leg)."""
+    from lightgbm_tpu.ops.predict import TreeArrays
+    from lightgbm_tpu.serve.artifact import PredictorArtifact
+    from lightgbm_tpu.serve.fleet import SwappablePredictor
+
+    section = {}
+    try:
+        art = packed.artifact
+        swapper = SwappablePredictor(packed, version=1)
+        lat_ms, new_compiles = [], 0
+        for i in range(n_swaps):
+            fields = {f: np.asarray(getattr(art.arrays, f))
+                      for f in TreeArrays.FIELDS}
+            fields["leaf_value"] = fields["leaf_value"] * (1.0 + 1e-9 * (i + 1))
+            retrain = PredictorArtifact(TreeArrays(**fields), art.meta)
+            stats = swapper.swap_to(retrain, version=i + 2,
+                                    warmup_max_rows=warmup_rows)
+            lat_ms.append(stats["swap_ms"])
+            new_compiles += stats["new_compiles"]
+        lat_ms.sort()
+        section = {
+            "swaps": n_swaps,
+            "swap_latency_p50_ms": round(lat_ms[len(lat_ms) // 2], 3),
+            "swap_latency_p99_ms": round(
+                lat_ms[min(len(lat_ms) - 1, int(0.99 * len(lat_ms)))], 3),
+            "swap_new_compiles": int(new_compiles),
+        }
+    except Exception as e:  # pragma: no cover — swap must not kill bench
         section["error"] = f"{type(e).__name__}: {e}"
     return section
 

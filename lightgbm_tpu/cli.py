@@ -275,6 +275,10 @@ def main(argv: List[str] = None) -> int:
         from .serve.server import main as serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "fleet":
+        from .serve.fleet import main as fleet_main
+
+        return fleet_main(argv[1:])
     if argv and argv[0] == "ingest":
         # subcommand sugar for task=ingest (matches report/serve style)
         argv = ["task=ingest"] + argv[1:]

@@ -110,6 +110,7 @@ class JitWatch:
         self.compiles = 0
         self.retraces = 0
         self._sigs = set()
+        self._last_cache_size = 0
         # serialize calls so a concurrent caller's compile can't land
         # inside another caller's before/after window and read as that
         # caller's (false) retrace — the serving batchers share one watch
@@ -133,10 +134,19 @@ class JitWatch:
     def _call_locked(self, args, kwargs):
         self.calls += 1
         before = self._cache_size()
+        # a shrunken cache means jax.clear_caches() (or a backend
+        # teardown) emptied the jit cache out from under us: every seen
+        # signature will legitimately compile again, so the seen set is
+        # from a dead cache lifetime — forget it instead of flagging the
+        # whole re-warm as retraces
+        if before is not None and before < self._last_cache_size:
+            self._sigs.clear()
         out = self._fn(*args, **kwargs)
         if before is None:
             return out
         after = self._cache_size()
+        if after is not None:
+            self._last_cache_size = after
         if after is not None and after > before:
             self.compiles += 1
             sig = _sig_of(args, kwargs)

@@ -18,13 +18,22 @@ things the training stack deliberately does not provide:
    requests coalesce into device-sized batches under
    ``max_batch_size``/``max_delay_ms`` with bounded queueing and
    overload shedding.
+4. **Fleet scale-out** (``registry.py``, ``fleet.py``,
+   ``python -m lightgbm_tpu fleet``) — a versioned on-disk model
+   registry with atomic CRC'd publishes, zero-downtime hot swap at
+   microbatch boundaries (zero new XLA compiles for same-shape
+   retrains, courtesy of the tree-shape compile-cache buckets), and a
+   replicated front end behind a health-checking load-balancing proxy.
 
 See docs/SERVING.md for the artifact format and operational knobs.
 """
 
 from .artifact import PackedPredictor, PredictorArtifact
 from .batcher import MicroBatcher, RequestTimeout, ServerOverloaded
-from .compilecache import BucketedRawPredictor, bucket_for, bucket_ladder
+from .compilecache import (BucketedRawPredictor, bucket_for, bucket_ladder,
+                           pad_tree_arrays, tree_shape_bucket)
+from .fleet import FleetProxy, SwappablePredictor
+from .registry import ModelRegistry
 
 __all__ = [
     "PredictorArtifact",
@@ -32,7 +41,12 @@ __all__ = [
     "BucketedRawPredictor",
     "bucket_for",
     "bucket_ladder",
+    "tree_shape_bucket",
+    "pad_tree_arrays",
     "MicroBatcher",
     "ServerOverloaded",
     "RequestTimeout",
+    "ModelRegistry",
+    "SwappablePredictor",
+    "FleetProxy",
 ]

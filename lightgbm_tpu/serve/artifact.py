@@ -116,22 +116,101 @@ class PredictorArtifact:
         # np.savez appends .npz when missing — report the real path
         return path if path.endswith(".npz") else path + ".npz"
 
+    def save_to_bytes(self, buf) -> None:
+        """Serialize into a writable binary file-like (the registry
+        publishes artifacts as bytes, never touching a temp path)."""
+        payload = {f: getattr(self.arrays, f) for f in TreeArrays.FIELDS}
+        payload["__meta__"] = np.asarray(json.dumps(self.meta))
+        np.savez_compressed(buf, **payload)
+
     @classmethod
     def load(cls, path: str) -> "PredictorArtifact":
-        with np.load(path, allow_pickle=False) as z:
-            if "__meta__" not in z:
-                Log.fatal("%s is not a packed predictor artifact (no __meta__)", path)
+        """Load a packed artifact, refusing anything that is not a
+        trustworthy current-format file with an actionable message
+        (mirrors the data/cache.py v2 refusal semantics): a corrupt or
+        truncated file, a future format version, and a missing field
+        set each name the remedy instead of surfacing a raw numpy
+        error."""
+        try:
+            z = np.load(path, allow_pickle=False)
+        except Exception as e:
+            # numpy raises OSError/ValueError/zipfile.BadZipFile
+            # depending on where the file is broken — fold them all into
+            # one actionable refusal, but never mask our own fatals
+            from ..utils.log import LightGBMError
+
+            if isinstance(e, LightGBMError):
+                raise
+            Log.fatal(
+                "%s is not a readable packed predictor artifact (%s: %s) "
+                "— the file is corrupt, truncated, or not an artifact; "
+                "re-pack it with PredictorArtifact.save / POST /models",
+                path, type(e).__name__, e)
+        with z:
+            return cls._from_npz(z, path)
+
+    @classmethod
+    def load_bytes(cls, blob: bytes) -> "PredictorArtifact":
+        """Load from in-memory ``.npz`` bytes (registry blobs, POST
+        /models upload bodies) with the same refusal semantics as
+        ``load``."""
+        import io
+
+        from ..utils.log import LightGBMError
+
+        try:
+            z = np.load(io.BytesIO(blob), allow_pickle=False)
+        except Exception as e:
+            if isinstance(e, LightGBMError):
+                raise
+            Log.fatal(
+                "artifact bytes are not a readable packed predictor "
+                "artifact (%s: %s) — corrupt or truncated upload",
+                type(e).__name__, e)
+        with z:
+            return cls._from_npz(z, "<bytes>")
+
+    @classmethod
+    def _from_npz(cls, z, origin: str) -> "PredictorArtifact":
+        if "__meta__" not in z:
+            Log.fatal(
+                "%s is not a packed predictor artifact (no __meta__ "
+                "entry); pack the model with PredictorArtifact.save",
+                origin)
+        try:
             meta = json.loads(str(z["__meta__"]))
-            version = int(meta.get("format_version", -1))
-            if version != FORMAT_VERSION:
-                Log.fatal(
-                    "Unsupported artifact format_version %s (supported: %d)",
-                    version, FORMAT_VERSION,
-                )
-            missing = [f for f in TreeArrays.FIELDS if f not in z]
-            if missing:
-                Log.fatal("Artifact %s is missing tree arrays: %s", path, missing)
+        except ValueError:
+            Log.fatal("%s carries an unparseable __meta__ header — the "
+                      "artifact is corrupt; re-pack it", origin)
+        version = int(meta.get("format_version", -1))
+        if version > FORMAT_VERSION:
+            Log.fatal(
+                "%s was written by a NEWER lightgbm_tpu (artifact "
+                "format_version %d, this build supports <= %d) — upgrade "
+                "this serving process, or re-pack the model with this "
+                "build", origin, version, FORMAT_VERSION)
+        if version != FORMAT_VERSION:
+            Log.fatal(
+                "%s uses unsupported artifact format_version %s "
+                "(supported: %d) — re-pack the model with "
+                "PredictorArtifact.save", origin, version, FORMAT_VERSION)
+        missing = [f for f in TreeArrays.FIELDS if f not in z]
+        if missing:
+            Log.fatal(
+                "Artifact %s is missing tree arrays %s — the file is "
+                "truncated or from an incompatible writer; re-pack it",
+                origin, missing)
+        try:
             arrays = TreeArrays(**{f: z[f] for f in TreeArrays.FIELDS})
+        except Exception as e:  # torn member: zipfile CRC error mid-read
+            from ..utils.log import LightGBMError
+
+            if isinstance(e, LightGBMError):
+                raise
+            Log.fatal(
+                "Artifact %s fails while reading its tree arrays (%s: %s) "
+                "— the file is corrupt; re-pack it", origin,
+                type(e).__name__, e)
         return cls(arrays, meta)
 
     # -- checks --------------------------------------------------------

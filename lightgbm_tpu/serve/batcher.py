@@ -34,6 +34,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from ..obs import metrics, tracer
+from ..utils.log import Log
 
 # shared across batcher instances (a server runs two — converted and
 # raw-score — and Prometheus wants the aggregate; per-batcher detail
@@ -72,7 +73,8 @@ class RequestTimeout(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("rows", "deadline", "done", "result", "error", "t_enqueue")
+    __slots__ = ("rows", "deadline", "done", "result", "error", "info",
+                 "t_enqueue")
 
     def __init__(self, rows: np.ndarray, deadline: float):
         self.rows = rows
@@ -80,6 +82,7 @@ class _Request:
         self.done = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
+        self.info = None  # batch-level metadata (e.g. model version)
         self.t_enqueue = time.perf_counter()
 
 
@@ -95,7 +98,12 @@ class MicroBatcher:
 
     ``predict_fn(batch) -> per-row outputs`` must return an array whose
     leading axis is the batch row axis ((N,) or (N, K)) — exactly the
-    ``PackedPredictor.predict`` contract.
+    ``PackedPredictor.predict`` contract.  It may instead return
+    ``(outputs, info)``: the extra ``info`` (a hot-swap predictor's
+    model version) is attached to every request of that batch and
+    surfaced through ``submit_ex`` — because it is sampled once per
+    BATCH, every request is attributable to exactly one model version
+    even across a swap boundary.
     """
 
     def __init__(
@@ -118,6 +126,8 @@ class MicroBatcher:
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._closed = False
+        self._draining = False
+        self._executing_rows = 0  # rows inside the running predict_fn
         self._counts = {"requests": 0, "rows": 0, "batches": 0,
                         "shed": 0, "timeouts": 0, "errors": 0}
         self._occupancy: collections.deque = collections.deque(maxlen=256)
@@ -133,16 +143,37 @@ class MicroBatcher:
         the per-row outputs for exactly these rows.  Raises
         ``ServerOverloaded`` (queue full), ``RequestTimeout`` (deadline
         expired before execution), or the predict error."""
+        return self._submit(rows, timeout_ms).result
+
+    def submit_ex(self, rows: np.ndarray,
+                  timeout_ms: Optional[float] = None):
+        """Like ``submit`` but returns ``(outputs, info)`` where
+        ``info`` is whatever the predict_fn returned alongside the
+        outputs for this request's batch (None for plain predict_fns or
+        empty requests)."""
+        req = self._submit(rows, timeout_ms)
+        return req.result, req.info
+
+    def _submit(self, rows: np.ndarray,
+                timeout_ms: Optional[float]) -> _Request:
         rows = np.asarray(rows, np.float64)
         if rows.ndim == 1:
             rows = rows.reshape(1, -1)
-        if rows.shape[0] == 0:
-            return np.empty((0,))
         tmo = self.request_timeout_ms if timeout_ms is None else float(timeout_ms)
         req = _Request(rows, deadline=time.monotonic() + tmo / 1e3)
+        if rows.shape[0] == 0:
+            req.result = np.empty((0,))
+            return req
         with self._lock:
             if self._closed:
                 raise RuntimeError("batcher is closed")
+            if self._draining:
+                # drain admits nothing new: queued work finishes, the
+                # caller sheds to another replica/model (HTTP 503)
+                self._counts["shed"] += 1
+                _M_SHED.inc()
+                tracer.counter("serve_shed")
+                raise ServerOverloaded("batcher is draining")
             if self._queued_rows + rows.shape[0] > self.max_queue_rows:
                 self._counts["shed"] += 1
                 _M_SHED.inc()
@@ -170,7 +201,7 @@ class MicroBatcher:
         lat = time.perf_counter() - req.t_enqueue
         self._latency_s.append(lat)
         _M_LATENCY.observe(lat)
-        return req.result
+        return req
 
     # -- batch loop ----------------------------------------------------
     def _take_batch(self) -> List[_Request]:
@@ -221,6 +252,8 @@ class MicroBatcher:
             batch = (taken[0].rows if len(taken) == 1
                      else np.concatenate([r.rows for r in taken], axis=0))
             self._occupancy.append(batch.shape[0])
+            with self._lock:
+                self._executing_rows = batch.shape[0]
             _M_QUEUE.set(self._queued_rows)
             _M_BATCH_ROWS.observe(batch.shape[0])
             tracer.gauge("serve_queue_depth", float(self._queued_rows))
@@ -237,28 +270,72 @@ class MicroBatcher:
                 for req in taken:
                     req.error = e
                     req.done.set()
+                with self._lock:
+                    self._executing_rows = 0
+                    self._wake.notify_all()
                 continue
+            # a predict_fn may return (outputs, info): the info —
+            # sampled once per batch — stamps every request with the
+            # single model version that produced its rows
+            info = None
+            if isinstance(out, tuple):
+                out, info = out
             start = 0
             for req in taken:
                 n = req.rows.shape[0]
                 req.result = np.asarray(out[start:start + n])
+                req.info = info
                 start += n
                 req.done.set()
+            with self._lock:
+                self._executing_rows = 0
+                self._wake.notify_all()
 
     # -- ops surface ---------------------------------------------------
     def stats(self) -> Dict:
         with self._lock:
             counts = dict(self._counts)
             depth = self._queued_rows
+            executing = self._executing_rows
+            draining = self._draining
         lat = sorted(self._latency_s)
         occ = list(self._occupancy)
         return {
             **counts,
             "queue_rows": depth,
+            "inflight_rows": depth + executing,
+            "draining": draining,
             "batch_occupancy_mean": round(float(np.mean(occ)), 2) if occ else 0.0,
             "latency_p50_ms": round(1e3 * _quantile(lat, 0.50), 3),
             "latency_p99_ms": round(1e3 * _quantile(lat, 0.99), 3),
         }
+
+    def drain(self, timeout_s: float = 10.0) -> bool:
+        """In-process drain (hot-swap uses this mid-life, not only at
+        exit): stop admitting new submits (they shed with
+        ``ServerOverloaded``), let everything queued and executing
+        finish, then settle the accounting — ``inflight_rows`` and
+        ``draining`` both read a stable ZERO after a completed drain.
+        Returns True when nothing was left in flight at the deadline."""
+        deadline = time.monotonic() + float(timeout_s)
+        with self._lock:
+            self._draining = True
+            self._wake.notify_all()
+            while self._queued_rows > 0 or self._executing_rows > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._wake.wait(min(remaining, 0.1))
+            drained = self._queued_rows == 0 and self._executing_rows == 0
+            # a COMPLETED drain settles to zero: not draining anymore,
+            # nothing in flight (the gauges-readable steady state)
+            if drained:
+                self._draining = False
+        if not drained:
+            Log.warning("batcher drain timed out with %d queued + %d "
+                        "executing rows", self._queued_rows,
+                        self._executing_rows)
+        return drained
 
     def close(self) -> None:
         with self._lock:

@@ -64,6 +64,49 @@ def _watch() -> JitWatch:
     return _watched_predict_raw
 
 
+def tree_shape_bucket(n: int) -> int:
+    """Canonical padded size for a stacked-tree axis (node count M or
+    leaf count L): the next power of two >= max(n, 2).
+
+    The XLA program cache keys on argument SHAPES, so two models whose
+    stacked arrays differ only in max-leaf count would compile twice —
+    a retrain with identical ``num_trees/num_leaves`` config can land on
+    M=14 where its predecessor had M=15 purely from data noise.  Padding
+    both up the same ladder makes the compile cache effectively keyed on
+    tree *shape class* instead of model identity: a hot swap to a
+    same-shape retrain inherits every warm program (zero new compiles —
+    the swap acceptance contract, pinned by tests/test_fleet.py).
+    Padded node slots are unreachable (traversal starts at node 0 and
+    only follows real child links) and padded leaf columns are never
+    gathered, so outputs are bit-identical."""
+    b = 2
+    while b < n:
+        b <<= 1
+    return b
+
+
+def pad_tree_arrays(arrays: TreeArrays) -> TreeArrays:
+    """Pad a host-side ``TreeArrays`` to canonical shape buckets
+    ((T, M) -> (T, bucket(M)), (T, L) -> (T, bucket(L))).  Returns the
+    input unchanged when already canonical.  Opt out with
+    ``LIGHTGBM_TPU_TREE_SHAPE_BUCKETS=0`` (exact observed shapes)."""
+    import os
+
+    if os.environ.get("LIGHTGBM_TPU_TREE_SHAPE_BUCKETS", "1") == "0":
+        return arrays
+    m = arrays.split_feature.shape[1]
+    L = arrays.leaf_value.shape[1]
+    mb, lb = tree_shape_bucket(m), tree_shape_bucket(L)
+    if mb == m and lb == L:
+        return arrays
+    fields = {}
+    for f in TreeArrays.FIELDS:
+        a = np.asarray(getattr(arrays, f))
+        pad = (lb if f == "leaf_value" else mb) - a.shape[1]
+        fields[f] = np.pad(a, ((0, 0), (0, pad))) if pad else a
+    return TreeArrays(**fields).validate()
+
+
 def bucket_for(n: int, min_bucket: int = DEFAULT_MIN_BUCKET,
                multiple_of: int = 1) -> int:
     """Smallest power-of-two >= max(n, min_bucket), rounded up to a
@@ -149,8 +192,12 @@ class BucketedRawPredictor:
     def from_tree_arrays(cls, arrays: TreeArrays, num_tree_per_iteration: int,
                          **kw) -> "BucketedRawPredictor":
         """Split the (T, ...) stacked arrays into per-class tuples
-        (class of tree i is i % k, matching GBDT.predict_raw_scores)."""
+        (class of tree i is i % k, matching GBDT.predict_raw_scores).
+        Arrays are padded to canonical tree-shape buckets first, so the
+        compiled programs are shared across models of the same shape
+        class (see ``tree_shape_bucket``)."""
         arrays.validate()
+        arrays = pad_tree_arrays(arrays)
         t = arrays.split_feature.shape[0]
         k = int(num_tree_per_iteration)
         if k <= 0 or t % k != 0:

@@ -1,0 +1,593 @@
+"""Serving fleet: zero-downtime hot swap + replicated front end.
+
+Two pieces, both riding on the existing serve/ layers:
+
+``SwappablePredictor`` — the hot-swap slot.  One replica process holds
+exactly one slot; the microbatchers' predict_fn samples the slot's
+``(version, PackedPredictor)`` pointer ONCE per device batch, so every
+batch — and therefore every request — is served by exactly one model
+version even while a swap lands.  ``swap_to`` loads and ``warmup()``s
+the incoming artifact in the calling (background) thread while traffic
+keeps flowing on the old model, flips the pointer at a microbatch
+boundary, then waits for the old version's in-flight batches to drain.
+Because the compile cache is keyed on tree SHAPE, not model identity
+(serve/compilecache.tree_shape_bucket), a retrain with the same
+``num_trees/num_leaves`` inherits every warm XLA program: the swap
+compiles NOTHING (pinned by tests/test_fleet.py).
+
+``FleetProxy`` — a tiny stdlib-HTTP load-balancing front end over N
+replica processes: round-robin or least-loaded backend choice,
+per-replica health ejection (a dead or connection-refusing backend is
+ejected and retried elsewhere within the same request — predict is
+idempotent, so a SIGKILLed replica mid-request costs a retry, never a
+dropped response), and a background ``/readyz`` prober that restores
+recovered backends.  ``python -m lightgbm_tpu fleet`` spawns N
+``serve`` subprocesses on a shared model registry plus the proxy.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..obs import compilewatch, tracer
+from ..obs.metrics import LATENCY_BUCKETS, registry as metrics_registry
+from ..utils.log import Log
+from .artifact import PackedPredictor, PredictorArtifact
+
+_M_SWAPS = metrics_registry.counter(
+    "lightgbm_tpu_serve_model_swaps_total",
+    "completed hot swaps to a new model version")
+_M_SWAP_SECONDS = metrics_registry.histogram(
+    "lightgbm_tpu_serve_swap_seconds",
+    "hot-swap latency: artifact load + warmup to traffic on the new model",
+    buckets=LATENCY_BUCKETS)
+_M_SWAP_COMPILES = metrics_registry.counter(
+    "lightgbm_tpu_serve_swap_compiles_total",
+    "XLA compiles attributable to hot swaps (0 for same-shape retrains)")
+_M_PROXY_REQS = metrics_registry.counter(
+    "lightgbm_tpu_proxy_requests_total", "requests handled by the proxy")
+_M_PROXY_RETRIES = metrics_registry.counter(
+    "lightgbm_tpu_proxy_retries_total",
+    "request attempts re-routed to another backend")
+_M_PROXY_EJECTIONS = metrics_registry.counter(
+    "lightgbm_tpu_proxy_ejections_total",
+    "backends ejected after a connection failure")
+_M_PROXY_LATENCY = metrics_registry.histogram(
+    "lightgbm_tpu_proxy_latency_seconds",
+    "proxy request latency including retries", buckets=LATENCY_BUCKETS)
+
+
+# ----------------------------------------------------------------------
+# hot-swap slot
+# ----------------------------------------------------------------------
+class SwappablePredictor:
+    """Version-stamped predictor slot with zero-downtime swap.
+
+    ``predict`` returns ``(outputs, version)``: the MicroBatcher calls
+    it once per device batch, so the version is sampled exactly once per
+    batch — the concurrent-swap attribution contract."""
+
+    def __init__(self, predictor: PackedPredictor, version: int = 1):
+        self._lock = threading.Lock()
+        self._drain_cv = threading.Condition(self._lock)
+        self._current: Tuple[int, PackedPredictor] = (int(version), predictor)
+        self._inflight: Dict[int, int] = {}
+        self._swaps = 0
+        self.last_swap: Dict = {}
+        metrics_registry.gauge(
+            "lightgbm_tpu_serve_model_version",
+            "model version currently receiving traffic",
+            fn=lambda: float(self.version))
+        metrics_registry.gauge(
+            "lightgbm_tpu_serve_draining_model_versions",
+            "old model versions still finishing in-flight batches",
+            fn=lambda: float(self.draining_versions))
+
+    # -- introspection -------------------------------------------------
+    @property
+    def version(self) -> int:
+        return self._current[0]
+
+    @property
+    def predictor(self) -> PackedPredictor:
+        return self._current[1]
+
+    @property
+    def artifact(self) -> PredictorArtifact:
+        return self._current[1].artifact
+
+    @property
+    def num_features(self) -> int:
+        return self._current[1].num_features
+
+    @property
+    def swaps(self) -> int:
+        return self._swaps
+
+    @property
+    def draining_versions(self) -> int:
+        with self._lock:
+            cur = self._current[0]
+            return sum(1 for v, n in self._inflight.items()
+                       if v != cur and n > 0)
+
+    # -- serving path --------------------------------------------------
+    def predict(self, batch: np.ndarray, raw_score: bool = False):
+        """(outputs, version) — the whole batch runs on ONE model."""
+        with self._lock:
+            ver, pred = self._current
+            self._inflight[ver] = self._inflight.get(ver, 0) + 1
+        try:
+            out = pred.predict(batch, raw_score=raw_score)
+        finally:
+            with self._drain_cv:
+                self._inflight[ver] -= 1
+                if self._inflight[ver] <= 0:
+                    self._inflight.pop(ver, None)
+                    self._drain_cv.notify_all()
+        return out, ver
+
+    def warmup(self, max_rows: int) -> Dict:
+        return self._current[1].warmup(max_rows)
+
+    # -- swap ----------------------------------------------------------
+    def swap_to(self, artifact: PredictorArtifact, version: int,
+                warmup_max_rows: int = 4096, do_warmup: bool = True,
+                drain_timeout_s: float = 30.0) -> Dict:
+        """Zero-downtime swap: build + warm the new predictor while the
+        old one keeps serving, flip the pointer (the next microbatch
+        runs on the new model), then wait for the old version's
+        in-flight batches to drain.  Returns swap stats including the
+        compile count the swap cost (0 for a same-shape retrain)."""
+        t0 = time.perf_counter()
+        c0 = compilewatch.total_compiles()
+        new_pred = PackedPredictor(artifact)
+        if do_warmup:
+            new_pred.warmup(warmup_max_rows)
+        with self._lock:
+            old_ver, _old_pred = self._current
+            self._current = (int(version), new_pred)
+            self._swaps += 1
+        swap_s = time.perf_counter() - t0
+        new_compiles = compilewatch.total_compiles() - c0
+        drained = self._wait_version_drained(old_ver, drain_timeout_s)
+        stats = {
+            "from_version": int(old_ver),
+            "to_version": int(version),
+            "swap_ms": round(1e3 * swap_s, 3),
+            "new_compiles": int(new_compiles),
+            "old_drained": bool(drained),
+        }
+        self.last_swap = stats
+        _M_SWAPS.inc()
+        _M_SWAP_SECONDS.observe(swap_s)
+        if new_compiles > 0:
+            _M_SWAP_COMPILES.inc(new_compiles)
+        tracer.event("serve.swap", **stats)
+        Log.info("serve: hot-swapped model v%d -> v%d in %.1f ms "
+                 "(%d new compiles, old %s)", old_ver, version,
+                 stats["swap_ms"], new_compiles,
+                 "drained" if drained else "DRAIN TIMED OUT")
+        return stats
+
+    def _wait_version_drained(self, version: int, timeout_s: float) -> bool:
+        deadline = time.monotonic() + float(timeout_s)
+        with self._drain_cv:
+            while self._inflight.get(version, 0) > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._drain_cv.wait(min(remaining, 0.1))
+        return True
+
+
+# ----------------------------------------------------------------------
+# load-balancing proxy
+# ----------------------------------------------------------------------
+class _Backend:
+    __slots__ = ("host", "port", "healthy", "inflight", "requests",
+                 "failures", "ejections")
+
+    def __init__(self, addr: str):
+        host, _, port = addr.rpartition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port)
+        self.healthy = True
+        self.inflight = 0
+        self.requests = 0
+        self.failures = 0
+        self.ejections = 0
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def as_dict(self) -> Dict:
+        return {"addr": self.addr, "healthy": self.healthy,
+                "inflight": self.inflight, "requests": self.requests,
+                "failures": self.failures, "ejections": self.ejections}
+
+
+class FleetProxy(ThreadingHTTPServer):
+    """Round-robin / least-loaded HTTP proxy with health ejection.
+
+    Local endpoints: ``/healthz`` (proxy liveness), ``/fleet/stats``
+    (per-backend health + counters), ``/metrics`` (Prometheus).
+    Everything else is forwarded to a healthy backend; connection
+    failures eject the backend and the request retries elsewhere until
+    ``retry_deadline_s`` — a response is dropped only when NO backend
+    answers for that long."""
+
+    daemon_threads = True
+
+    def __init__(self, addr, backends: List[str], policy: str = "least_loaded",
+                 backend_timeout_s: float = 30.0, health_poll_s: float = 0.5,
+                 retry_deadline_s: float = 10.0):
+        if not backends:
+            Log.fatal("fleet proxy needs at least one backend")
+        if policy not in ("least_loaded", "rr"):
+            Log.fatal("unknown proxy policy %r (least_loaded or rr)", policy)
+        self.backends = [_Backend(b) for b in backends]
+        self.policy = policy
+        self.backend_timeout_s = float(backend_timeout_s)
+        self.health_poll_s = float(health_poll_s)
+        self.retry_deadline_s = float(retry_deadline_s)
+        self._block = threading.Lock()
+        self._rr = 0
+        self._stop = threading.Event()
+        self.t_start = time.time()
+        metrics_registry.gauge(
+            "lightgbm_tpu_proxy_healthy_backends",
+            "backends currently accepting traffic",
+            fn=lambda: float(sum(1 for b in self.backends if b.healthy)))
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="ltpu-fleet-health", daemon=True)
+        super().__init__(addr, _ProxyHandler)
+        self._health_thread.start()
+
+    # -- backend choice ------------------------------------------------
+    def pick(self, exclude: Optional[set] = None) -> Optional[_Backend]:
+        exclude = exclude or set()
+        with self._block:
+            candidates = [b for b in self.backends
+                          if b.healthy and b.addr not in exclude]
+            if not candidates:
+                # all excluded this attempt round: fall back to any
+                # healthy backend (it may have recovered)
+                candidates = [b for b in self.backends if b.healthy]
+            if not candidates:
+                return None
+            self._rr += 1
+            if self.policy == "rr":
+                chosen = candidates[self._rr % len(candidates)]
+            else:
+                # least-loaded, with a rotating tie-break so idle fleets
+                # still spread sequential traffic instead of hammering
+                # the first backend
+                lo = min(b.inflight for b in candidates)
+                tied = [b for b in candidates if b.inflight == lo]
+                chosen = tied[self._rr % len(tied)]
+            chosen.inflight += 1
+            chosen.requests += 1
+            return chosen
+
+    def release(self, backend: _Backend) -> None:
+        with self._block:
+            backend.inflight = max(0, backend.inflight - 1)
+
+    def eject(self, backend: _Backend) -> None:
+        with self._block:
+            backend.failures += 1
+            if backend.healthy:
+                backend.healthy = False
+                backend.ejections += 1
+                _M_PROXY_EJECTIONS.inc()
+                Log.warning("fleet: ejected backend %s after a "
+                            "connection failure", backend.addr)
+
+    # -- health probing ------------------------------------------------
+    def _probe(self, backend: _Backend) -> bool:
+        try:
+            conn = http.client.HTTPConnection(backend.host, backend.port,
+                                              timeout=2.0)
+            try:
+                conn.request("GET", "/readyz")
+                return conn.getresponse().status == 200
+            finally:
+                conn.close()
+        except OSError:
+            return False
+        except http.client.HTTPException:
+            return False
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.health_poll_s):
+            for b in self.backends:
+                ok = self._probe(b)
+                with self._block:
+                    if ok and not b.healthy:
+                        Log.info("fleet: backend %s recovered", b.addr)
+                    b.healthy = ok
+
+    # -- ops surface ---------------------------------------------------
+    def stats(self) -> Dict:
+        with self._block:
+            backends = [b.as_dict() for b in self.backends]
+        return {
+            "uptime_s": round(time.time() - self.t_start, 1),
+            "policy": self.policy,
+            "healthy": sum(1 for b in backends if b["healthy"]),
+            "backends": backends,
+        }
+
+    def shutdown(self):
+        self._stop.set()
+        super().shutdown()
+
+
+class _ProxyHandler(BaseHTTPRequestHandler):
+    server_version = "lightgbm-tpu-fleet/1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        Log.debug("fleet: " + fmt, *args)
+
+    def _reply(self, code: int, payload: bytes,
+               headers: Optional[List[Tuple[str, str]]] = None) -> None:
+        self.send_response(code)
+        sent = set()
+        for k, v in headers or []:
+            if k.lower() in ("content-type", "x-model-version"):
+                self.send_header(k, v)
+                sent.add(k.lower())
+        if "content-type" not in sent:
+            self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _reply_json(self, code: int, obj) -> None:
+        self._reply(code, (json.dumps(obj) + "\n").encode())
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._reply_json(200, {"status": "ok", "role": "proxy"})
+        elif self.path == "/fleet/stats":
+            self._reply_json(200, self.server.stats())
+        elif self.path == "/metrics":
+            self._reply(200, metrics_registry.render().encode(),
+                        headers=[("Content-Type",
+                                  "text/plain; version=0.0.4; charset=utf-8")])
+        else:
+            self._forward("GET", body=None)
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        self._forward("POST", body=body)
+
+    def _forward(self, method: str, body: Optional[bytes]) -> None:
+        """Relay to a healthy backend; eject-and-retry on connection
+        failures, re-route 503s (draining/overloaded replica) when
+        another backend exists.  Predict requests are idempotent, so a
+        retry can never double-apply anything."""
+        srv: FleetProxy = self.server
+        t0 = time.perf_counter()
+        _M_PROXY_REQS.inc()
+        deadline = time.monotonic() + srv.retry_deadline_s
+        tried_this_round: set = set()
+        unavailable_503 = 0
+        attempt = 0
+        while True:
+            backend = srv.pick(exclude=tried_this_round)
+            if backend is None:
+                if time.monotonic() > deadline:
+                    self._reply_json(502, {
+                        "error": "no healthy backend",
+                        "attempts": attempt,
+                    })
+                    return
+                time.sleep(0.05)
+                tried_this_round.clear()  # health loop may restore one
+                continue
+            attempt += 1
+            try:
+                status, headers, payload = self._try_backend(
+                    srv, backend, method, body)
+            except (OSError, http.client.HTTPException):
+                srv.eject(backend)
+                tried_this_round.add(backend.addr)
+                _M_PROXY_RETRIES.inc()
+                if time.monotonic() > deadline:
+                    self._reply_json(502, {
+                        "error": "no backend answered before the retry "
+                                 "deadline", "attempts": attempt})
+                    return
+                continue
+            finally:
+                srv.release(backend)
+            if status == 503 and unavailable_503 < len(srv.backends):
+                # draining/overloaded replica: give the others a shot,
+                # but relay the 503 once every backend said it
+                unavailable_503 += 1
+                tried_this_round.add(backend.addr)
+                _M_PROXY_RETRIES.inc()
+                if time.monotonic() <= deadline:
+                    continue
+            _M_PROXY_LATENCY.observe(time.perf_counter() - t0)
+            self._reply(status, payload, headers=headers)
+            return
+
+    def _try_backend(self, srv: FleetProxy, backend: _Backend,
+                     method: str, body: Optional[bytes]):
+        conn = http.client.HTTPConnection(
+            backend.host, backend.port, timeout=srv.backend_timeout_s)
+        try:
+            conn.request(method, self.path, body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            payload = resp.read()
+            return resp.status, resp.getheaders(), payload
+        finally:
+            conn.close()
+
+
+# ----------------------------------------------------------------------
+# fleet launcher — N serve subprocesses + the proxy
+# ----------------------------------------------------------------------
+FLEET_DEFAULTS = {
+    "replicas": 2,
+    "port": 9095,
+    "base_port": 0,
+    "health_poll_ms": 500,
+    "retry_deadline_ms": 10000,
+    "ready_timeout_ms": 120000,
+}
+
+
+def _free_ports(n: int, host: str = "127.0.0.1") -> List[int]:
+    import socket
+
+    socks, ports = [], []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind((host, 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
+    return ports
+
+
+def _wait_ready(host: str, port: int, timeout_s: float) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=2.0)
+            try:
+                conn.request("GET", "/readyz")
+                if conn.getresponse().status == 200:
+                    return True
+            finally:
+                conn.close()
+        except (OSError, http.client.HTTPException):
+            pass
+        time.sleep(0.1)
+    return False
+
+
+def spawn_replicas(n: int, serve_params: Dict[str, str],
+                   ports: Optional[List[int]] = None,
+                   host: str = "127.0.0.1") -> List[Tuple[subprocess.Popen, int]]:
+    """Launch ``n`` ``python -m lightgbm_tpu serve`` subprocesses."""
+    ports = ports or _free_ports(n, host)
+    procs = []
+    for port in ports[:n]:
+        argv = [sys.executable, "-m", "lightgbm_tpu", "serve",
+                f"host={host}", f"port={port}"]
+        argv += [f"{k}={v}" for k, v in serve_params.items()]
+        procs.append((subprocess.Popen(argv), port))
+    return procs
+
+
+def main(argv: List[str]) -> int:
+    """``python -m lightgbm_tpu fleet model=...|registry=... replicas=N
+    port=... [backends=h:p,h:p] [policy=least_loaded|rr] [serve knobs]``.
+
+    With ``backends=`` the proxy fronts already-running replicas;
+    otherwise it spawns ``replicas`` serve subprocesses (sharing
+    ``registry=`` when given, so one publish hot-swaps the whole fleet)
+    and supervises them.  SIGTERM drains: replicas get SIGTERM (their
+    own graceful drain), then the proxy stops."""
+    from ..cli import parse_argv
+
+    tracer.refresh_from_env()
+    params = parse_argv(argv)
+    opts = dict(FLEET_DEFAULTS)
+    for k in list(opts):
+        if k in params:
+            opts[k] = type(opts[k])(float(params[k]))
+    host = str(params.get("host", "127.0.0.1"))
+    policy = str(params.get("policy", "least_loaded"))
+
+    procs: List[Tuple[subprocess.Popen, int]] = []
+    if params.get("backends"):
+        backends = [b.strip() for b in params["backends"].split(",")
+                    if b.strip()]
+    else:
+        if not (params.get("model") or params.get("registry")):
+            Log.warning("fleet: need model=..., registry=..., or "
+                        "backends=host:port,...")
+            return 1
+        passthrough = {
+            k: v for k, v in params.items()
+            if k not in ("host", "port", "replicas", "base_port", "policy",
+                         "backends", "health_poll_ms", "retry_deadline_ms",
+                         "ready_timeout_ms")
+        }
+        n = int(opts["replicas"])
+        ports = (list(range(int(opts["base_port"]),
+                            int(opts["base_port"]) + n))
+                 if int(opts["base_port"]) else None)
+        procs = spawn_replicas(n, passthrough, ports=ports, host=host)
+        backends = [f"{host}:{port}" for _, port in procs]
+        for _, port in procs:
+            if not _wait_ready(host, port,
+                               float(opts["ready_timeout_ms"]) / 1e3):
+                Log.warning("fleet: replica on port %d never became ready",
+                            port)
+                for p, _ in procs:
+                    p.terminate()
+                return 1
+        Log.info("fleet: %d replica(s) ready on %s", n, backends)
+
+    proxy = FleetProxy(
+        (host, int(opts["port"])), backends, policy=policy,
+        health_poll_s=float(opts["health_poll_ms"]) / 1e3,
+        retry_deadline_s=float(opts["retry_deadline_ms"]) / 1e3,
+    )
+    bound = proxy.server_address[1]
+    Log.info("fleet: proxy listening on http://%s:%d over %d backend(s)",
+             host, bound, len(backends))
+
+    def _on_sigterm(signum, frame):
+        Log.warning("fleet: SIGTERM — draining replicas and stopping proxy")
+        for p, _ in procs:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        threading.Thread(target=proxy.shutdown, daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:  # pragma: no cover - embedded in a non-main thread
+        pass
+
+    try:
+        proxy.serve_forever()
+    except KeyboardInterrupt:
+        _on_sigterm(signal.SIGINT, None)
+        proxy.shutdown()
+    finally:
+        proxy.server_close()
+        for p, _ in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    Log.info("fleet: stopped")
+    return 0

@@ -1,0 +1,338 @@
+"""Versioned on-disk model registry — the serving fleet's source of truth.
+
+A serving replica must survive model churn: every retrain publishes a
+new ``PredictorArtifact`` and every replica picks it up WITHOUT a
+restart (docs/SERVING.md, hot swap).  The registry is a plain directory
+any publisher (trainer, CI, ``POST /models``) and any number of replica
+processes share:
+
+  registry_dir/
+    v00000001.npz     packed PredictorArtifact, immutable once published
+    v00000002.npz
+    MANIFEST.json     {"entries": {name: {version, crc32, size, ts,
+                       num_trees, num_features, ...}},
+                       "active_version": int|null}
+
+Write protocol (the ckpt/store.py atomic dance, reused literally):
+artifact bytes -> tmp + fsync -> hardlink-claim of the next free
+``vNNNNNNNN.npz`` name -> directory fsync -> manifest rewritten through
+tmp+fsync+rename.  A crash at any point leaves either no trace or an
+orphan data file without a manifest entry, which discovery ignores; a
+corrupt/truncated artifact fails its manifest CRC at load time and is
+refused with a clear error instead of serving garbage.
+
+Watching is poll-based (no inotify dependency): ``watch_token()`` is a
+cheap stat of the manifest; replicas poll it and reload on change.
+Publishing is cross-process safe: the version name is claimed with an
+exclusive hardlink and the manifest read-modify-write runs under a
+bounded ``.lock`` file (stale locks from a crashed publisher are broken
+after ``LOCK_STALE_S``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+from ..ckpt.store import _atomic_write, _fsync_dir
+from ..utils.log import Log
+from .artifact import PredictorArtifact
+
+_PREFIX = "v"
+_SUFFIX = ".npz"
+_MANIFEST = "MANIFEST.json"
+_LOCK = ".publish.lock"
+
+LOCK_STALE_S = 30.0
+LOCK_WAIT_S = 10.0
+
+
+def _version_name(version: int) -> str:
+    return f"{_PREFIX}{int(version):08d}{_SUFFIX}"
+
+
+def _version_of(name: str) -> Optional[int]:
+    base = os.path.basename(name)
+    if not (base.startswith(_PREFIX) and base.endswith(_SUFFIX)):
+        return None
+    try:
+        return int(base[len(_PREFIX): -len(_SUFFIX)])
+    except ValueError:
+        return None
+
+
+class _PublishLock:
+    """Bounded O_EXCL lock file serializing manifest read-modify-write
+    across publisher processes.  A lock older than ``LOCK_STALE_S`` is
+    from a crashed publisher and is broken with a warning."""
+
+    def __init__(self, directory: str, wait_s: float = LOCK_WAIT_S):
+        self.path = os.path.join(directory, _LOCK)
+        self.wait_s = float(wait_s)
+
+    def __enter__(self):
+        deadline = time.monotonic() + self.wait_s
+        while True:
+            try:
+                fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.write(fd, str(os.getpid()).encode())
+                os.close(fd)
+                return self
+            except FileExistsError:
+                try:
+                    age = time.time() - os.stat(self.path).st_mtime
+                    if age > LOCK_STALE_S:
+                        Log.warning(
+                            "registry: breaking stale publish lock %s "
+                            "(%.0fs old)", self.path, age)
+                        os.unlink(self.path)
+                        continue
+                except OSError:
+                    continue  # lock vanished between stat attempts
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"registry publish lock {self.path} held for "
+                        f">{self.wait_s}s")
+                time.sleep(0.02)
+
+    def __exit__(self, *exc):
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class ModelRegistry:
+    """Directory of immutable versioned artifacts + atomic CRC'd manifest."""
+
+    def __init__(self, directory: str, keep_last: int = 0):
+        self.dir = directory
+        # keep_last=0 keeps everything; retention never removes the
+        # active version (a replica may still be draining onto it)
+        self.keep_last = max(0, int(keep_last))
+        os.makedirs(self.dir, exist_ok=True)
+
+    # -- manifest ------------------------------------------------------
+    def _manifest_path(self) -> str:
+        return os.path.join(self.dir, _MANIFEST)
+
+    def read_manifest(self) -> Dict:
+        try:
+            with open(self._manifest_path()) as f:
+                m = json.load(f)
+            if isinstance(m, dict) and isinstance(m.get("entries"), dict):
+                return m
+        except (OSError, ValueError):
+            pass
+        return {"entries": {}, "active_version": None}
+
+    def _write_manifest(self, manifest: Dict) -> None:
+        _atomic_write(self._manifest_path(),
+                      json.dumps(manifest, indent=1).encode())
+
+    # -- publish -------------------------------------------------------
+    def publish(self, artifact: PredictorArtifact,
+                activate: bool = True) -> int:
+        """Publish a validated in-memory artifact; returns its version."""
+        import io
+
+        buf = io.BytesIO()
+        artifact.save_to_bytes(buf)
+        return self.publish_bytes(buf.getvalue(), activate=activate,
+                                  _validated_meta=dict(artifact.meta))
+
+    def publish_file(self, path: str, activate: bool = True) -> int:
+        with open(path, "rb") as f:
+            return self.publish_bytes(f.read(), activate=activate)
+
+    def seed(self, artifact: PredictorArtifact) -> int:
+        """Publish ``artifact`` only if the registry is still empty once
+        the publish lock is held — N replicas racing to seed a shared
+        registry produce exactly one version.  Returns the version now
+        active (the seed's, or the one that won the race)."""
+        import io
+
+        buf = io.BytesIO()
+        artifact.save_to_bytes(buf)
+        return self.publish_bytes(buf.getvalue(),
+                                  _validated_meta=dict(artifact.meta),
+                                  _only_if_empty=True)
+
+    def publish_bytes(self, blob: bytes, activate: bool = True,
+                      _validated_meta: Optional[Dict] = None,
+                      _only_if_empty: bool = False) -> int:
+        """Publish raw ``.npz`` artifact bytes (the ``POST /models``
+        body).  The blob is fully validated through
+        ``PredictorArtifact.load`` BEFORE it can claim a version — a
+        corrupt upload never enters the manifest."""
+        meta = _validated_meta
+        if meta is None:
+            meta = dict(PredictorArtifact.load_bytes(blob).meta)
+        tmp = os.path.join(self.dir, f".publish.tmp.{os.getpid()}")
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            with _PublishLock(self.dir):
+                manifest = self.read_manifest()
+                if _only_if_empty and manifest["entries"]:
+                    active = manifest.get("active_version")
+                    if active is not None:
+                        return int(active)
+                    return max(int(e["version"])
+                               for e in manifest["entries"].values())
+                version = self._next_version(manifest)
+                path = os.path.join(self.dir, _version_name(version))
+                # hardlink-claim: fails loudly if the name exists (a
+                # publisher outside the lock), never overwrites
+                os.link(tmp, path)
+                _fsync_dir(self.dir)
+                manifest["entries"][os.path.basename(path)] = {
+                    "version": version,
+                    "crc32": zlib.crc32(blob) & 0xFFFFFFFF,
+                    "size": len(blob),
+                    "ts": round(time.time(), 3),
+                    "num_trees": int(meta.get("num_trees", 0)),
+                    "num_features": int(meta.get("num_features", 0)),
+                    "num_class": int(meta.get("num_class", 1)),
+                    "objective": str(meta.get("objective", "")),
+                }
+                if activate:
+                    manifest["active_version"] = version
+                self._gc(manifest)
+                self._write_manifest(manifest)
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        from ..obs import tracer
+        from ..obs.metrics import registry as metrics_registry
+
+        tracer.event("registry.published", version=version,
+                     bytes=len(blob), active=bool(activate))
+        metrics_registry.counter(
+            "lightgbm_tpu_registry_publish_total",
+            "artifacts published into the model registry").inc()
+        return version
+
+    def _next_version(self, manifest: Dict) -> int:
+        top = 0
+        for e in manifest["entries"].values():
+            top = max(top, int(e["version"]))
+        # also scan the directory: an orphan data file from a crashed
+        # publisher must not be overwritten by a version-number reuse
+        try:
+            for name in os.listdir(self.dir):
+                v = _version_of(name)
+                if v is not None:
+                    top = max(top, v)
+        except OSError:
+            pass
+        return top + 1
+
+    def activate(self, version: int) -> None:
+        """Point ``active_version`` at an already-published version
+        (rollback is just activating an older one)."""
+        with _PublishLock(self.dir):
+            manifest = self.read_manifest()
+            if not any(int(e["version"]) == int(version)
+                       for e in manifest["entries"].values()):
+                Log.fatal("registry: cannot activate unknown version %s "
+                          "(published: %s)", version,
+                          sorted(int(e["version"])
+                                 for e in manifest["entries"].values()))
+            manifest["active_version"] = int(version)
+            self._write_manifest(manifest)
+
+    def _gc(self, manifest: Dict) -> None:
+        if self.keep_last <= 0:
+            return
+        entries = manifest["entries"]
+        active = manifest.get("active_version")
+        versions = sorted((int(e["version"]), name)
+                          for name, e in entries.items())
+        while len(versions) > self.keep_last:
+            v, name = versions.pop(0)
+            if v == active:
+                continue  # never collect what replicas are serving
+            entries.pop(name, None)
+            try:
+                os.unlink(os.path.join(self.dir, name))
+            except OSError:
+                pass
+
+    # -- read side -----------------------------------------------------
+    def list_models(self) -> List[Dict]:
+        """Manifest entries, oldest first, with the active flag set."""
+        manifest = self.read_manifest()
+        active = manifest.get("active_version")
+        out = []
+        for name, e in sorted(manifest["entries"].items(),
+                              key=lambda kv: int(kv[1]["version"])):
+            row = dict(e)
+            row["name"] = name
+            row["active"] = int(e["version"]) == active if active else False
+            out.append(row)
+        return out
+
+    def active_version(self) -> Optional[int]:
+        v = self.read_manifest().get("active_version")
+        return int(v) if v is not None else None
+
+    def latest_version(self) -> Optional[int]:
+        versions = [int(e["version"])
+                    for e in self.read_manifest()["entries"].values()]
+        return max(versions) if versions else None
+
+    def load(self, version: int) -> PredictorArtifact:
+        """Load + CRC-verify a published version.  A corrupt or
+        truncated file is refused with the manifest evidence — never
+        silently served."""
+        manifest = self.read_manifest()
+        entry = None
+        for name, e in manifest["entries"].items():
+            if int(e["version"]) == int(version):
+                entry = (name, e)
+                break
+        if entry is None:
+            Log.fatal("registry: version %s is not in %s (published: %s)",
+                      version, self.dir,
+                      sorted(int(e["version"])
+                             for e in manifest["entries"].values()))
+        name, e = entry
+        path = os.path.join(self.dir, name)
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError as ex:
+            Log.fatal("registry: cannot read %s: %s", path, ex)
+        if len(blob) != int(e.get("size", -1)) or (
+                zlib.crc32(blob) & 0xFFFFFFFF) != int(e.get("crc32", -1)):
+            Log.fatal(
+                "registry: %s fails its manifest CRC/size check "
+                "(%d bytes vs %s recorded) — the artifact is corrupt or "
+                "torn; republish it", path, len(blob), e.get("size"))
+        return PredictorArtifact.load_bytes(blob)
+
+    def load_active(self) -> Optional[Tuple[int, PredictorArtifact]]:
+        v = self.active_version()
+        if v is None:
+            return None
+        return v, self.load(v)
+
+    # -- watch ---------------------------------------------------------
+    def watch_token(self) -> Tuple:
+        """Cheap change token: manifest identity (size + mtime_ns) plus
+        the active version.  Polling replicas reload when it changes —
+        no inotify, works on any filesystem including network mounts."""
+        try:
+            st = os.stat(self._manifest_path())
+            ident = (int(st.st_size), int(st.st_mtime_ns))
+        except OSError:
+            ident = (0, 0)
+        return ident + (self.active_version(),)

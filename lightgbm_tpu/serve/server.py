@@ -4,8 +4,20 @@ Endpoints:
   POST /predict      body: one JSON row per line — either ``[f0, f1, ...]``
                      or ``{"features": [...]}``.  Response: one JSON
                      prediction per line, same order (a float, or a list
-                     for multiclass).  ``?raw_score=1`` skips the
+                     for multiclass), with the serving model version in
+                     the ``X-Model-Version`` header (``?model_version=1``
+                     additionally stamps every line as
+                     ``{"prediction": ..., "model_version": N}`` — each
+                     request is answered by exactly ONE version even
+                     across a hot swap).  ``?raw_score=1`` skips the
                      objective's output conversion.
+  POST /models       registry mode only: the body is a packed ``.npz``
+                     artifact; it is validated, published into the model
+                     registry as the next version, activated, and
+                     hot-swapped into this replica without dropping a
+                     request (serve/fleet.py).
+  GET  /models       registry mode only: the registry listing plus the
+                     version this replica is currently serving.
   GET  /healthz      liveness only: ``{"status": "ok"}`` whenever the
                      process answers.
   GET  /readyz       readiness: 200 once the artifact is loaded AND the
@@ -36,6 +48,15 @@ Startup: ``model=`` accepts either a packed ``.npz`` artifact
 packed on the fly.  Unless ``warmup=0``, the bucket ladder is
 precompiled before the socket starts accepting, so the first real
 request never pays an XLA compile.
+
+Registry mode (``registry=dir``): the replica serves the registry's
+active version and polls ``watch_token()`` every ``registry_poll_ms``;
+when a publisher (another process, or ``POST /models`` on any replica
+sharing the directory) activates a new version, the replica hot-swaps
+to it at a microbatch boundary with zero dropped requests — and, for a
+same-shape retrain, zero new XLA compiles (serve/compilecache.py tree
+shape buckets).  An empty registry is seeded from ``model=`` when
+given.
 """
 
 from __future__ import annotations
@@ -51,9 +72,11 @@ import numpy as np
 
 from ..obs import compilewatch, tracer
 from ..obs.metrics import registry as metrics_registry
-from ..utils.log import Log
+from ..utils.log import LightGBMError, Log
 from .artifact import PackedPredictor, PredictorArtifact
 from .batcher import MicroBatcher, RequestTimeout, ServerOverloaded
+from .fleet import SwappablePredictor
+from .registry import ModelRegistry
 
 DEFAULTS = {
     "port": 9090,
@@ -65,17 +88,21 @@ DEFAULTS = {
     "warmup_max_rows": 4096,
     "shard": 0,
     "drain_timeout_ms": 10000,
+    "registry_poll_ms": 500.0,
 }
 
 
-def load_predictor(model_path: str, shard: bool = False) -> PackedPredictor:
+def load_artifact(model_path: str) -> PredictorArtifact:
     """Load a packed ``.npz`` artifact, or pack a model text file."""
     if model_path.endswith(".npz"):
-        artifact = PredictorArtifact.load(model_path)
-    else:
-        from ..basic import Booster
+        return PredictorArtifact.load(model_path)
+    from ..basic import Booster
 
-        artifact = PredictorArtifact.from_booster(Booster(model_file=model_path))
+    return PredictorArtifact.from_booster(Booster(model_file=model_path))
+
+
+def make_predictor(artifact: PredictorArtifact,
+                   shard: bool = False) -> PackedPredictor:
     predictor = PackedPredictor(artifact)
     if shard:
         from .compilecache import BucketedRawPredictor
@@ -84,6 +111,10 @@ def load_predictor(model_path: str, shard: bool = False) -> PackedPredictor:
             artifact.arrays, artifact.num_tree_per_iteration, shard=True
         )
     return predictor
+
+
+def load_predictor(model_path: str, shard: bool = False) -> PackedPredictor:
+    return make_predictor(load_artifact(model_path), shard=shard)
 
 
 def _parse_rows(body: bytes) -> np.ndarray:
@@ -117,8 +148,11 @@ class PredictServer(ThreadingHTTPServer):
 
     daemon_threads = True
 
-    def __init__(self, addr, predictor: PackedPredictor,
-                 batcher_opts: Optional[Dict] = None):
+    def __init__(self, addr, predictor,
+                 batcher_opts: Optional[Dict] = None,
+                 registry: Optional[ModelRegistry] = None,
+                 registry_poll_ms: float = 500.0,
+                 warmup_max_rows: int = 4096, do_warmup: bool = True):
         self.predictor = predictor
         opts = dict(batcher_opts or {})
         self.batcher = MicroBatcher(
@@ -129,12 +163,23 @@ class PredictServer(ThreadingHTTPServer):
             lambda batch: predictor.predict(batch, raw_score=True),
             **opts,
         )
+        self.registry = registry
+        self.registry_poll_ms = float(registry_poll_ms)
+        self._warmup_max_rows = int(warmup_max_rows)
+        self._do_warmup = bool(do_warmup)
+        self._swap_lock = threading.Lock()
+        self._watch_stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
         self.t_start = time.time()
         # readiness/drain state (docs/ROBUSTNESS.md): ready flips on
         # once the artifact is loaded and warmup completed; draining
-        # flips /readyz and /predict to 503 while in-flight batches run
+        # flips /readyz and /predict to 503 while in-flight batches run;
+        # drained marks a COMPLETED drain (draining settles back to
+        # False so the state gauges read a stable zero — the satellite-2
+        # accounting contract)
         self.ready = False
         self.draining = False
+        self.drained = False
         self._inflight = 0
         self._inflight_cv = threading.Condition()
         # scrape-time state gauges: evaluated inside /metrics rendering,
@@ -156,7 +201,63 @@ class PredictServer(ThreadingHTTPServer):
             "lightgbm_tpu_serve_uptime_seconds",
             "seconds since this server process started serving",
             fn=lambda: time.time() - self.t_start)
+        if registry is not None:
+            # scrape-time registry views: a manifest read is host-side
+            # file I/O only (never jax), cheap enough per scrape
+            metrics_registry.gauge(
+                "lightgbm_tpu_registry_models",
+                "artifact versions published in the model registry",
+                fn=lambda: float(len(registry.read_manifest()["entries"])))
+            metrics_registry.gauge(
+                "lightgbm_tpu_registry_active_version",
+                "version the registry manifest currently activates",
+                fn=lambda: float(registry.active_version() or 0))
         super().__init__(addr, _Handler)
+
+    # -- registry / hot swap -------------------------------------------
+    def maybe_swap(self) -> Optional[Dict]:
+        """Hot-swap to the registry's active version if it differs from
+        the one serving.  Serialized so the watcher thread and a POST
+        /models handler cannot double-load; returns the swap stats, or
+        None when already current (or not in registry mode)."""
+        if self.registry is None:
+            return None
+        with self._swap_lock:
+            target = self.registry.active_version()
+            if target is None or target == self.predictor.version:
+                return None
+            artifact = self.registry.load(target)
+            return self.predictor.swap_to(
+                artifact, target, warmup_max_rows=self._warmup_max_rows,
+                do_warmup=self._do_warmup)
+
+    def start_registry_watcher(self) -> None:
+        """Poll the registry's change token and swap on activation —
+        inotify-free, so it works on any shared filesystem."""
+        if self.registry is None or self._watch_thread is not None:
+            return
+        poll_s = max(self.registry_poll_ms, 1.0) / 1e3
+
+        def _loop():
+            token = self.registry.watch_token()
+            while not self._watch_stop.wait(poll_s):
+                t = self.registry.watch_token()
+                if t == token:
+                    continue
+                token = t
+                try:
+                    self.maybe_swap()
+                except Exception as e:
+                    # a torn publish or corrupt artifact must not kill
+                    # the serving loop — keep the current model and retry
+                    # on the next token change
+                    Log.warning("serve: registry swap failed (still on "
+                                "v%s): %s", getattr(self.predictor,
+                                                    "version", "?"), e)
+
+        self._watch_thread = threading.Thread(
+            target=_loop, name="ltpu-registry-watch", daemon=True)
+        self._watch_thread.start()
 
     # -- in-flight request accounting ----------------------------------
     def track_begin(self) -> None:
@@ -171,10 +272,13 @@ class PredictServer(ThreadingHTTPServer):
 
     def drain(self, timeout_s: float = 10.0) -> bool:
         """Graceful shutdown: stop admitting work (``/readyz`` and
-        ``/predict`` answer 503), wait for in-flight microbatches to
-        finish (bounded by ``timeout_s``), then stop the accept loop and
-        close the batchers.  Returns True when the drain completed with
-        nothing in flight."""
+        ``/predict`` answer 503), wait for in-flight HTTP requests AND
+        the batchers' queued/executing rows to finish (bounded by
+        ``timeout_s``), then stop the accept loop and close the
+        batchers.  Returns True when the drain completed with nothing in
+        flight — in which case ``draining`` settles back to False (and
+        ``drained`` latches True), so the inflight/draining gauges read
+        a stable zero instead of being stuck at 1 forever."""
         self.draining = True
         deadline = time.monotonic() + float(timeout_s)
         with self._inflight_cv:
@@ -184,22 +288,32 @@ class PredictServer(ThreadingHTTPServer):
                     break
                 self._inflight_cv.wait(min(remaining, 0.1))
             drained = self._inflight == 0
+        # settle the batchers too: every queued AND executing row must
+        # reach zero before the drain counts as complete
+        for b in (self.batcher, self.raw_batcher):
+            remaining = max(0.0, deadline - time.monotonic())
+            drained = b.drain(remaining) and drained
         if not drained:
             Log.warning("serve: drain timed out with %d request(s) in "
                         "flight", self._inflight)
         self.shutdown()
+        if drained:
+            self.draining = False
+        self.drained = True
         return drained
 
     def stats(self) -> Dict:
         cw = compilewatch.snapshot()
         watched = cw["watched"].get("serve.predict_raw", {})
-        return {
+        out = {
             "uptime_s": round(time.time() - self.t_start, 1),
             "ready": self.ready,
             "draining": self.draining,
+            "drained": self.drained,
             "inflight": self._inflight,
             "num_features": self.predictor.num_features,
             "num_class": self.predictor.artifact.num_class,
+            "model_version": getattr(self.predictor, "version", None),
             "batcher": self.batcher.stats(),
             "raw_batcher": self.raw_batcher.stats(),
             "compiles": {
@@ -209,8 +323,22 @@ class PredictServer(ThreadingHTTPServer):
                 "predict_retraces": watched.get("retraces", 0),
             },
         }
+        if isinstance(self.predictor, SwappablePredictor):
+            out["swap"] = {
+                "swaps": self.predictor.swaps,
+                "draining_versions": self.predictor.draining_versions,
+                "last": self.predictor.last_swap,
+            }
+        if self.registry is not None:
+            out["registry"] = {
+                "dir": self.registry.dir,
+                "active_version": self.registry.active_version(),
+                "models": len(self.registry.read_manifest()["entries"]),
+            }
+        return out
 
     def shutdown(self):
+        self._watch_stop.set()
         super().shutdown()
         self.batcher.close()
         self.raw_batcher.close()
@@ -224,9 +352,12 @@ class _Handler(BaseHTTPRequestHandler):
         Log.debug("serve: " + fmt, *args)
 
     def _reply(self, code: int, payload: bytes,
-               ctype: str = "application/json") -> None:
+               ctype: str = "application/json",
+               extra_headers: Optional[List] = None) -> None:
         self.send_response(code)
         self.send_header("Content-Type", ctype)
+        for k, v in extra_headers or []:
+            self.send_header(k, str(v))
         self.send_header("Content-Length", str(len(payload)))
         self.end_headers()
         self.wfile.write(payload)
@@ -238,7 +369,9 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             self._reply_json(200, {"status": "ok"})
         elif self.path == "/readyz":
-            if self.server.draining:
+            if self.server.drained:
+                self._reply_json(503, {"status": "stopped"})
+            elif self.server.draining:
                 self._reply_json(503, {"status": "draining"})
             elif not self.server.ready:
                 self._reply_json(503, {"status": "warming"})
@@ -246,6 +379,17 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply_json(200, {"status": "ready"})
         elif self.path == "/stats":
             self._reply_json(200, self.server.stats())
+        elif self.path == "/models":
+            if self.server.registry is None:
+                self._reply_json(404, {"error": "no model registry "
+                                                "(start with registry=dir)"})
+            else:
+                self._reply_json(200, {
+                    "models": self.server.registry.list_models(),
+                    "active_version": self.server.registry.active_version(),
+                    "serving_version": getattr(self.server.predictor,
+                                               "version", None),
+                })
         elif self.path == "/metrics":
             # Prometheus text format; render() never touches jax, so a
             # scrape storm cannot compile or serialize device work
@@ -256,10 +400,13 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         path, _, query = self.path.partition("?")
+        if path == "/models":
+            self._do_publish()
+            return
         if path != "/predict":
             self._reply_json(404, {"error": f"unknown path {path}"})
             return
-        if self.server.draining:
+        if self.server.draining or self.server.drained:
             # shed-not-queue during drain: the LB already saw /readyz
             # flip; anything still arriving is told to go elsewhere
             self._reply_json(503, {"error": "server is draining"})
@@ -270,8 +417,41 @@ class _Handler(BaseHTTPRequestHandler):
         finally:
             self.server.track_end()
 
+    def _do_publish(self) -> None:
+        """POST /models: validate + publish the uploaded artifact bytes,
+        then hot-swap this replica to it (other replicas polling the
+        shared registry follow within their poll interval)."""
+        if self.server.registry is None:
+            self._reply_json(404, {"error": "no model registry "
+                                            "(start with registry=dir)"})
+            return
+        length = int(self.headers.get("Content-Length") or 0)
+        blob = self.rfile.read(length) if length else b""
+        if not blob:
+            self._reply_json(400, {"error": "empty artifact upload"})
+            return
+        try:
+            version = self.server.registry.publish_bytes(blob)
+        except (LightGBMError, TimeoutError) as e:
+            self._reply_json(400, {"error": str(e)})
+            return
+        swap = None
+        try:
+            swap = self.server.maybe_swap()
+        except Exception as e:
+            Log.warning("serve: swap to freshly published v%d failed: %s",
+                        version, e)
+        self._reply_json(200, {
+            "version": version,
+            "active_version": self.server.registry.active_version(),
+            "serving_version": getattr(self.server.predictor, "version",
+                                       None),
+            "swap": swap,
+        })
+
     def _do_predict(self, query: str) -> None:
         raw_score = "raw_score=1" in query
+        stamp_version = "model_version=1" in query
         try:
             length = int(self.headers.get("Content-Length") or 0)
             rows = _parse_rows(self.rfile.read(length))
@@ -280,7 +460,7 @@ class _Handler(BaseHTTPRequestHandler):
             return
         batcher = self.server.raw_batcher if raw_score else self.server.batcher
         try:
-            preds = batcher.submit(rows)
+            preds, version = batcher.submit_ex(rows)
         except ServerOverloaded as e:
             self._reply_json(503, {"error": str(e)})
             return
@@ -291,24 +471,62 @@ class _Handler(BaseHTTPRequestHandler):
             Log.warning("serve: predict failed: %s", e)
             self._reply_json(500, {"error": f"{type(e).__name__}: {e}"})
             return
-        lines = [json.dumps(p.tolist() if isinstance(p, np.ndarray) else float(p))
-                 for p in preds]
+
+        def _plain(p):
+            return p.tolist() if isinstance(p, np.ndarray) else float(p)
+
+        if stamp_version:
+            lines = [json.dumps({"prediction": _plain(p),
+                                 "model_version": version})
+                     for p in preds]
+        else:
+            lines = [json.dumps(_plain(p)) for p in preds]
+        headers = ([("X-Model-Version", int(version))]
+                   if version is not None else [])
         self._reply(200, ("\n".join(lines) + "\n").encode(),
-                    ctype="application/jsonl")
+                    ctype="application/jsonl", extra_headers=headers)
 
 
-def make_server(model_path: str, host: str = "127.0.0.1", port: int = 0,
-                warmup_max_rows: int = 4096, shard: bool = False,
-                do_warmup: bool = True, **batcher_opts) -> PredictServer:
+def make_server(model_path: Optional[str] = None, host: str = "127.0.0.1",
+                port: int = 0, warmup_max_rows: int = 4096,
+                shard: bool = False, do_warmup: bool = True,
+                registry_dir: Optional[str] = None,
+                registry_poll_ms: float = 500.0,
+                **batcher_opts) -> PredictServer:
     """Build (and optionally warm) a ready-to-run server; ``port=0``
-    binds an ephemeral port (tests)."""
-    predictor = load_predictor(model_path, shard=shard)
-    server = PredictServer((host, port), predictor, batcher_opts)
+    binds an ephemeral port (tests).  With ``registry_dir`` the server
+    serves the registry's active version and hot-swaps on activation;
+    an empty registry is seeded from ``model_path``."""
+    registry = ModelRegistry(registry_dir) if registry_dir else None
+    version = 1
+    if registry is not None:
+        if registry.active_version() is None:
+            if not model_path:
+                Log.fatal("serve: registry %s is empty and no model= was "
+                          "given to seed it", registry_dir)
+            # lock-guarded: N replicas racing to seed the same shared
+            # registry publish exactly one v1
+            registry.seed(load_artifact(model_path))
+        version, artifact = registry.load_active()
+        predictor = make_predictor(artifact, shard=shard)
+    else:
+        if not model_path:
+            Log.fatal("serve: need model=path.npz|model.txt (or "
+                      "registry=dir)")
+        predictor = load_predictor(model_path, shard=shard)
+    swapper = SwappablePredictor(predictor, version=version)
+    server = PredictServer((host, port), swapper, batcher_opts,
+                           registry=registry,
+                           registry_poll_ms=registry_poll_ms,
+                           warmup_max_rows=warmup_max_rows,
+                           do_warmup=do_warmup)
     if do_warmup:
-        stats = predictor.warmup(warmup_max_rows)
+        stats = swapper.warmup(warmup_max_rows)
         Log.info("serve: warmup compiled %d programs over buckets %s in %.2fs",
                  stats["compiles"], stats["buckets"], stats["secs"])
     server.ready = True  # artifact loaded + warmup complete -> /readyz 200
+    if registry is not None:
+        server.start_registry_watcher()
     return server
 
 
@@ -319,8 +537,10 @@ def main(argv: List[str]) -> int:
     tracer.refresh_from_env()
     params = parse_argv(argv)
     model_path = params.get("model") or params.get("input_model")
-    if not model_path:
-        Log.warning("serve: no model file (model=path.npz or model=model.txt)")
+    registry_dir = params.get("registry")
+    if not model_path and not registry_dir:
+        Log.warning("serve: no model file (model=path.npz or model=model.txt"
+                    ", or registry=dir)")
         return 1
     opts = dict(DEFAULTS)
     for k in list(opts):
@@ -333,6 +553,8 @@ def main(argv: List[str]) -> int:
         warmup_max_rows=int(opts["warmup_max_rows"]),
         shard=bool(opts["shard"]),
         do_warmup=bool(opts["warmup"]),
+        registry_dir=registry_dir,
+        registry_poll_ms=float(opts["registry_poll_ms"]),
         max_batch_size=int(opts["max_batch_size"]),
         max_delay_ms=float(opts["max_delay_ms"]),
         max_queue_rows=int(opts["max_queue_rows"]),

@@ -1,0 +1,757 @@
+"""Serving-fleet tests: the versioned model registry (atomic publish,
+CRC refusal, rollback, watch token), zero-downtime hot swap (same-shape
+retrain => ZERO new XLA compiles — the tree-shape-bucket acceptance
+contract), concurrent-swap version attribution (every request answered
+by exactly one model version), the load-balancing proxy (health
+ejection, retry-on-failure, 503 re-route), and the multi-replica smoke:
+2 subprocess replicas behind the proxy surviving a hot swap AND a
+SIGKILL with zero dropped or mis-versioned responses.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import compilewatch
+from lightgbm_tpu.ops.predict import TreeArrays
+from lightgbm_tpu.serve import (
+    FleetProxy,
+    ModelRegistry,
+    PackedPredictor,
+    PredictorArtifact,
+    SwappablePredictor,
+    pad_tree_arrays,
+    tree_shape_bucket,
+)
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+@pytest.fixture(scope="module")
+def binary_booster():
+    rng = np.random.RandomState(3)
+    X = rng.randn(600, 12)
+    y = (X[:, 0] + 0.5 * X[:, 1] - X[:, 2] ** 2 > -0.5).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, params={"min_data_in_leaf": 5})
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 15, "verbose": -1},
+        ds, num_boost_round=12, verbose_eval=False,
+    )
+    return bst, X
+
+
+def _retrain_artifact(art: PredictorArtifact, scale: float) -> PredictorArtifact:
+    """A same-shape 'retrain': identical tree geometry, scaled leaves."""
+    fields = {f: np.asarray(getattr(art.arrays, f))
+              for f in TreeArrays.FIELDS}
+    fields["leaf_value"] = fields["leaf_value"] * scale
+    return PredictorArtifact(TreeArrays(**fields), art.meta)
+
+
+def _artifact_bytes(art: PredictorArtifact) -> bytes:
+    import io
+
+    buf = io.BytesIO()
+    art.save_to_bytes(buf)
+    return buf.getvalue()
+
+
+# ----------------------------------------------------------------------
+# tree-shape compile-cache buckets
+# ----------------------------------------------------------------------
+class TestTreeShapeBuckets:
+    def test_bucket_ladder(self):
+        assert tree_shape_bucket(1) == 2
+        assert tree_shape_bucket(2) == 2
+        assert tree_shape_bucket(3) == 4
+        assert tree_shape_bucket(15) == 16
+        assert tree_shape_bucket(16) == 16
+        assert tree_shape_bucket(17) == 32
+
+    def test_pad_is_canonical_and_bit_identical(self, binary_booster,
+                                                monkeypatch):
+        bst, X = binary_booster
+        art = PredictorArtifact.from_booster(bst)
+        padded = pad_tree_arrays(art.arrays)
+        m = padded.split_feature.shape[1]
+        L = padded.leaf_value.shape[1]
+        assert m == tree_shape_bucket(art.arrays.split_feature.shape[1])
+        assert L == tree_shape_bucket(art.arrays.leaf_value.shape[1])
+        # padded predictor output is bit-identical to the opt-out path
+        got = PackedPredictor(art).predict(X[:40])
+        monkeypatch.setenv("LIGHTGBM_TPU_TREE_SHAPE_BUCKETS", "0")
+        exact = PackedPredictor(art).predict(X[:40])
+        assert np.array_equal(got, exact)
+
+    def test_pad_noop_when_canonical(self):
+        kw = {f: np.zeros((3, 4), np.int32) for f in TreeArrays.FIELDS}
+        kw["leaf_value"] = np.zeros((3, 8), np.float32)
+        arrays = TreeArrays(**kw)
+        assert pad_tree_arrays(arrays) is arrays
+
+
+# ----------------------------------------------------------------------
+# model registry
+# ----------------------------------------------------------------------
+class TestRegistry:
+    def test_publish_list_activate(self, binary_booster, tmp_path):
+        bst, _ = binary_booster
+        art = PredictorArtifact.from_booster(bst)
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        assert reg.active_version() is None
+        v1 = reg.publish(art)
+        v2 = reg.publish(_retrain_artifact(art, 1.1))
+        assert (v1, v2) == (1, 2)
+        assert reg.active_version() == 2
+        models = reg.list_models()
+        assert [m["version"] for m in models] == [1, 2]
+        assert [m["active"] for m in models] == [False, True]
+        assert models[0]["num_trees"] == art.meta["num_trees"]
+        # rollback is just activating the older version
+        reg.activate(1)
+        assert reg.active_version() == 1
+        with pytest.raises(LightGBMError, match="unknown version"):
+            reg.activate(99)
+
+    def test_publish_without_activate(self, binary_booster, tmp_path):
+        bst, _ = binary_booster
+        art = PredictorArtifact.from_booster(bst)
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        reg.publish(art)
+        reg.publish(_retrain_artifact(art, 1.1), activate=False)
+        assert reg.active_version() == 1
+        assert reg.latest_version() == 2
+
+    def test_load_roundtrip(self, binary_booster, tmp_path):
+        bst, X = binary_booster
+        art = PredictorArtifact.from_booster(bst)
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        reg.publish(art)
+        ver, loaded = reg.load_active()
+        assert ver == 1
+        assert loaded.meta == art.meta
+        assert np.array_equal(
+            PackedPredictor(loaded).predict(X[:8]), bst.predict(X[:8]))
+
+    def test_corrupt_artifact_refused_by_crc(self, binary_booster, tmp_path):
+        bst, _ = binary_booster
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        v = reg.publish(PredictorArtifact.from_booster(bst))
+        path = os.path.join(reg.dir, f"v{v:08d}.npz")
+        with open(path, "r+b") as f:  # flip bytes mid-file (torn write)
+            f.seek(100)
+            f.write(b"\xff\xff\xff\xff")
+        with pytest.raises(LightGBMError, match="corrupt or torn"):
+            reg.load(v)
+
+    def test_corrupt_upload_never_enters_manifest(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        with pytest.raises(LightGBMError):
+            reg.publish_bytes(b"not an artifact")
+        assert reg.list_models() == []
+        assert [n for n in os.listdir(reg.dir) if n.endswith(".npz")] == []
+
+    def test_watch_token_changes_on_publish_and_activate(
+            self, binary_booster, tmp_path):
+        bst, _ = binary_booster
+        art = PredictorArtifact.from_booster(bst)
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        t0 = reg.watch_token()
+        reg.publish(art)
+        t1 = reg.watch_token()
+        assert t1 != t0
+        reg.publish(_retrain_artifact(art, 1.1))
+        t2 = reg.watch_token()
+        assert t2 != t1
+        reg.activate(1)
+        assert reg.watch_token() != t2
+        assert reg.watch_token() == reg.watch_token()  # stable when idle
+
+    def test_gc_keeps_last_and_never_active(self, binary_booster, tmp_path):
+        bst, _ = binary_booster
+        art = PredictorArtifact.from_booster(bst)
+        reg = ModelRegistry(str(tmp_path / "reg"), keep_last=2)
+        reg.publish(art)                                   # v1
+        reg.publish(_retrain_artifact(art, 1.1))           # v2
+        reg.activate(1)
+        reg.publish(_retrain_artifact(art, 1.2), activate=False)  # v3
+        reg.publish(_retrain_artifact(art, 1.3), activate=False)  # v4
+        versions = [m["version"] for m in reg.list_models()]
+        # v1 survives retention because it is ACTIVE; v2 was collected
+        assert 1 in versions and 2 not in versions
+        assert len(versions) <= 3
+
+    def test_concurrent_seed_publishes_exactly_one_version(
+            self, binary_booster, tmp_path):
+        """N replicas pointed at the same empty registry all seed it on
+        startup; the emptiness re-check under the publish lock must
+        collapse the race to ONE published version."""
+        bst, _ = binary_booster
+        art = PredictorArtifact.from_booster(bst)
+        reg_dir = str(tmp_path / "reg")
+        n = 4
+        barrier = threading.Barrier(n)
+        got = []
+
+        def seed():
+            reg = ModelRegistry(reg_dir)
+            barrier.wait()
+            got.append(reg.seed(art))
+
+        threads = [threading.Thread(target=seed) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert got == [1] * n
+        reg = ModelRegistry(reg_dir)
+        assert [m["version"] for m in reg.list_models()] == [1]
+        assert reg.active_version() == 1
+        # a seed against a populated registry is a no-op returning the
+        # active version, not a new publish
+        reg.activate(1)
+        assert reg.seed(_retrain_artifact(art, 1.1)) == 1
+        assert [m["version"] for m in reg.list_models()] == [1]
+
+    def test_orphan_file_never_overwritten(self, binary_booster, tmp_path):
+        """A crashed publisher's orphan data file (no manifest entry)
+        must not be clobbered by version-number reuse."""
+        bst, _ = binary_booster
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        orphan = os.path.join(reg.dir, "v00000005.npz")
+        with open(orphan, "wb") as f:
+            f.write(b"orphan from a crashed publisher")
+        v = reg.publish(PredictorArtifact.from_booster(bst))
+        assert v == 6
+        with open(orphan, "rb") as f:
+            assert f.read() == b"orphan from a crashed publisher"
+
+
+# ----------------------------------------------------------------------
+# hot swap
+# ----------------------------------------------------------------------
+class TestSwappablePredictor:
+    def test_predict_returns_version(self, binary_booster):
+        bst, X = binary_booster
+        packed = PackedPredictor(PredictorArtifact.from_booster(bst))
+        sw = SwappablePredictor(packed, version=3)
+        out, ver = sw.predict(X[:5])
+        assert ver == 3
+        assert np.array_equal(out, bst.predict(X[:5]))
+
+    def test_same_shape_swap_zero_new_compiles(self, binary_booster):
+        """THE tentpole contract: a warmed predictor hot-swapped to a
+        same-shape retrain compiles NOTHING — the compile cache is keyed
+        on tree shape buckets, not model identity."""
+        bst, X = binary_booster
+        art = PredictorArtifact.from_booster(bst)
+        packed = PackedPredictor(art)
+        packed.warmup(256)
+        sw = SwappablePredictor(packed, version=1)
+        retrain = _retrain_artifact(art, 1.25)
+        c0 = compilewatch.total_compiles()
+        stats = sw.swap_to(retrain, version=2, warmup_max_rows=256)
+        assert stats["new_compiles"] == 0, \
+            "same-shape hot swap paid an XLA compile"
+        assert compilewatch.total_compiles() == c0
+        assert stats["old_drained"] is True
+        out, ver = sw.predict(X[:7])
+        assert ver == 2
+        assert np.array_equal(out, PackedPredictor(retrain).predict(X[:7]))
+
+    def test_same_config_retrain_shares_programs(self, binary_booster):
+        """A REAL retrain (different data -> different observed node
+        counts) lands in the same shape bucket and inherits the warm
+        programs."""
+        bst, X = binary_booster
+        rng = np.random.RandomState(17)  # different rows, same config
+        X2 = rng.randn(500, 12)
+        y2 = (X2[:, 0] - X2[:, 1] > 0).astype(np.float32)
+        bst2 = lgb.train(
+            {"objective": "binary", "num_leaves": 15, "verbose": -1},
+            lgb.Dataset(X2, label=y2, params={"min_data_in_leaf": 5}),
+            num_boost_round=12, verbose_eval=False,
+        )
+        art1 = PredictorArtifact.from_booster(bst)
+        art2 = PredictorArtifact.from_booster(bst2)
+        packed = PackedPredictor(art1)
+        packed.warmup(256)
+        sw = SwappablePredictor(packed, version=1)
+        stats = sw.swap_to(art2, version=2, warmup_max_rows=256)
+        assert stats["new_compiles"] == 0, \
+            "same-config retrain missed the warm shape-bucket programs"
+        out, ver = sw.predict(X[:9])
+        assert ver == 2
+        assert np.array_equal(out, bst2.predict(X[:9]))
+
+    def test_concurrent_swap_exactly_one_version(self, binary_booster):
+        """Satellite 3 (unit level): requests racing a hot swap each get
+        a response from exactly one model version, and the outputs match
+        that version's model bit-for-bit."""
+        bst, X = binary_booster
+        art = PredictorArtifact.from_booster(bst)
+        packed = PackedPredictor(art)
+        packed.warmup(64)
+        retrain = _retrain_artifact(art, 2.0)
+        expected = {
+            1: bst.predict(X[:4]),
+            2: PackedPredictor(retrain).predict(X[:4]),
+        }
+        sw = SwappablePredictor(packed, version=1)
+        stop = threading.Event()
+        errors, seen = [], set()
+
+        def hammer():
+            while not stop.is_set():
+                out, ver = sw.predict(X[:4])
+                seen.add(ver)
+                if ver not in expected:
+                    errors.append(f"unknown version {ver}")
+                elif not np.array_equal(out, expected[ver]):
+                    errors.append(f"v{ver} output does not match v{ver} model")
+
+        threads = [threading.Thread(target=hammer, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.15)
+        sw.swap_to(retrain, version=2, warmup_max_rows=64)
+        time.sleep(0.15)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors, errors[:3]
+        assert seen == {1, 2}  # traffic really straddled the swap
+        assert sw.draining_versions == 0  # old version fully drained
+
+
+# ----------------------------------------------------------------------
+# proxy (in-process fake backends — no jax involved)
+# ----------------------------------------------------------------------
+class _FakeBackend:
+    """Minimal replica double: /readyz 200, /predict echoes a canned
+    version, optional forced-503 mode (a draining replica)."""
+
+    def __init__(self, version=1, always_503=False):
+        fake = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, body, headers=()):
+                self.send_response(code)
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                self._send(200 if self.path == "/readyz" else 404, b"{}\n")
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                self.rfile.read(n)
+                if fake.always_503:
+                    self._send(503, b'{"error": "draining"}\n')
+                else:
+                    self._send(200, b"0.5\n",
+                               [("X-Model-Version", str(fake.version))])
+
+        self.version = version
+        self.always_503 = always_503
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self.addr = f"127.0.0.1:{self.port}"
+        self._t = threading.Thread(target=self.httpd.serve_forever,
+                                   daemon=True)
+        self._t.start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _start_proxy(backends, **kw):
+    proxy = FleetProxy(("127.0.0.1", 0), [b.addr for b in backends],
+                       health_poll_s=0.1, retry_deadline_s=5.0, **kw)
+    t = threading.Thread(target=proxy.serve_forever, daemon=True)
+    t.start()
+    return proxy, proxy.server_address[1]
+
+
+def _proxy_predict(port, timeout=30):
+    r = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/predict", data=b"[1.0, 2.0]\n",
+        timeout=timeout)
+    return r.status, r.headers.get("X-Model-Version")
+
+
+class TestFleetProxy:
+    def test_balances_and_relays_headers(self):
+        backends = [_FakeBackend(version=7), _FakeBackend(version=7)]
+        proxy, port = _start_proxy(backends)
+        try:
+            for _ in range(8):
+                status, ver = _proxy_predict(port)
+                assert (status, ver) == (200, "7")
+            st = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fleet/stats", timeout=30).read())
+            assert st["healthy"] == 2
+            reqs = [b["requests"] for b in st["backends"]]
+            assert all(r > 0 for r in reqs), "one backend never picked"
+        finally:
+            proxy.shutdown()
+            proxy.server_close()
+            for b in backends:
+                b.stop()
+
+    def test_dead_backend_ejected_and_retried(self):
+        """A SIGKILLed replica costs a retry, never a dropped response:
+        connection failures eject the backend and the request re-routes
+        within the same call."""
+        backends = [_FakeBackend(), _FakeBackend()]
+        proxy, port = _start_proxy(backends)
+        try:
+            backends[0].stop()  # dead: connection refused from now on
+            for _ in range(6):
+                status, _ = _proxy_predict(port)
+                assert status == 200  # zero dropped
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                st = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/fleet/stats",
+                    timeout=30).read())
+                if st["healthy"] == 1:
+                    break
+                time.sleep(0.05)
+            assert st["healthy"] == 1
+        finally:
+            proxy.shutdown()
+            proxy.server_close()
+            backends[1].stop()
+
+    def test_503_reroutes_to_another_backend(self):
+        """A draining replica's 503 re-routes; the client sees 200."""
+        backends = [_FakeBackend(always_503=True), _FakeBackend()]
+        proxy, port = _start_proxy(backends, policy="rr")
+        try:
+            for _ in range(6):
+                status, _ = _proxy_predict(port)
+                assert status == 200
+        finally:
+            proxy.shutdown()
+            proxy.server_close()
+            for b in backends:
+                b.stop()
+
+    def test_all_503_relayed(self):
+        backends = [_FakeBackend(always_503=True)]
+        proxy, port = _start_proxy(backends)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _proxy_predict(port)
+            assert ei.value.code == 503
+        finally:
+            proxy.shutdown()
+            proxy.server_close()
+            backends[0].stop()
+
+
+# ----------------------------------------------------------------------
+# registry-backed server (in-process, HTTP)
+# ----------------------------------------------------------------------
+class TestServerRegistryMode:
+    @pytest.fixture()
+    def server(self, binary_booster, tmp_path):
+        from lightgbm_tpu.serve.server import make_server
+
+        bst, X = binary_booster
+        model = PredictorArtifact.from_booster(bst).save(str(tmp_path / "m"))
+        srv = make_server(model, port=0, warmup_max_rows=64,
+                          max_delay_ms=1.0,
+                          registry_dir=str(tmp_path / "reg"),
+                          registry_poll_ms=50.0)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        yield srv, bst, X
+        srv.shutdown()
+        srv.server_close()
+
+    def _post_rows(self, port, rows, query=""):
+        body = "\n".join(json.dumps(list(map(float, r))) for r in rows).encode()
+        return urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/predict{query}", data=body, timeout=30)
+
+    def test_seeded_from_model_and_lists(self, server):
+        srv, bst, X = server
+        port = srv.server_address[1]
+        r = self._post_rows(port, X[:3])
+        assert r.headers["X-Model-Version"] == "1"
+        listing = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/models", timeout=30).read())
+        assert listing["active_version"] == 1
+        assert listing["serving_version"] == 1
+        assert [m["version"] for m in listing["models"]] == [1]
+
+    def test_post_models_hot_swaps(self, server):
+        srv, bst, X = server
+        port = srv.server_address[1]
+        retrain = _retrain_artifact(
+            PredictorArtifact.from_booster(bst), 1.5)
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/models",
+            data=_artifact_bytes(retrain), timeout=60)
+        reply = json.loads(r.read())
+        assert reply["version"] == 2
+        assert reply["serving_version"] == 2
+        assert reply["swap"]["new_compiles"] == 0  # same-shape retrain
+        r = self._post_rows(port, X[:5], query="?model_version=1")
+        lines = [json.loads(l) for l in r.read().decode().splitlines()]
+        assert all(l["model_version"] == 2 for l in lines)
+        assert np.allclose(
+            [l["prediction"] for l in lines],
+            PackedPredictor(retrain).predict(X[:5]))
+        st = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats", timeout=30).read())
+        assert st["model_version"] == 2
+        assert st["swap"]["swaps"] >= 1
+        assert st["registry"]["active_version"] == 2
+
+    def test_post_models_rejects_garbage(self, server):
+        srv, _, _ = server
+        port = srv.server_address[1]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/models",
+                                   data=b"garbage bytes", timeout=30)
+        assert ei.value.code == 400
+        listing = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/models", timeout=30).read())
+        assert len(listing["models"]) == 1  # nothing entered the registry
+
+    def test_watcher_follows_out_of_band_publish(self, server):
+        """Another process publishing into the shared registry directory
+        is picked up by the poll watcher without any HTTP involvement."""
+        srv, bst, X = server
+        port = srv.server_address[1]
+        reg = ModelRegistry(srv.registry.dir)  # an independent publisher
+        retrain = _retrain_artifact(PredictorArtifact.from_booster(bst), 0.5)
+        v = reg.publish(retrain)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if getattr(srv.predictor, "version", None) == v:
+                break
+            time.sleep(0.05)
+        assert srv.predictor.version == v
+        r = self._post_rows(port, X[:2])
+        assert r.headers["X-Model-Version"] == str(v)
+
+    def test_models_404_without_registry(self, binary_booster, tmp_path):
+        from lightgbm_tpu.serve.server import make_server
+
+        bst, _ = binary_booster
+        model = PredictorArtifact.from_booster(bst).save(str(tmp_path / "m"))
+        srv = make_server(model, port=0, warmup_max_rows=64)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            port = srv.server_address[1]
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/models", timeout=30)
+            assert ei.value.code == 404
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
+# ----------------------------------------------------------------------
+# multi-replica fleet (subprocess replicas + proxy)
+# ----------------------------------------------------------------------
+def _spawn_fleet(registry_dir, n=2):
+    from lightgbm_tpu.serve.fleet import _wait_ready, spawn_replicas
+
+    procs = spawn_replicas(n, {
+        "registry": registry_dir,
+        "warmup_max_rows": "64",
+        "max_delay_ms": "1",
+        "registry_poll_ms": "100",
+    })
+    try:
+        for _, port in procs:
+            assert _wait_ready("127.0.0.1", port, 120.0), \
+                f"replica on port {port} never became ready"
+    except BaseException:
+        for p, _ in procs:
+            p.kill()
+        raise
+    return procs
+
+
+def _closed_loop(port, rows, expected, duration_s, n_threads=4):
+    """Drive closed-loop traffic through the proxy; every reply must be
+    200 and stamped with exactly one KNOWN version whose predictions it
+    matches.  Returns (responses, errors, versions_seen, latencies)."""
+    body = "\n".join(json.dumps(list(map(float, r))) for r in rows).encode()
+    stop = time.monotonic() + duration_s
+    lock = threading.Lock()
+    stats = {"n": 0, "errors": [], "versions": set(), "lat": []}
+
+    def worker():
+        while time.monotonic() < stop:
+            t0 = time.perf_counter()
+            try:
+                r = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/predict?model_version=1",
+                    data=body, timeout=60)
+                lines = [json.loads(l)
+                         for l in r.read().decode().splitlines()]
+            except Exception as e:
+                with lock:
+                    stats["errors"].append(f"{type(e).__name__}: {e}")
+                continue
+            lat = time.perf_counter() - t0
+            vers = {l["model_version"] for l in lines}
+            err = None
+            if len(vers) != 1:
+                err = f"reply mixed versions {vers}"
+            else:
+                ver = vers.pop()
+                if ver not in expected:
+                    err = f"unknown version {ver}"
+                elif not np.allclose([l["prediction"] for l in lines],
+                                     expected[ver]):
+                    err = f"v{ver} reply does not match v{ver} model"
+            with lock:
+                stats["n"] += 1
+                stats["lat"].append(lat)
+                if err:
+                    stats["errors"].append(err)
+                else:
+                    stats["versions"].add(ver)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    return threads, stats
+
+
+@pytest.mark.fleet
+class TestFleetSmoke:
+    """Tier-1 smoke: 2 subprocess replicas sharing a registry behind the
+    proxy; one hot swap and one SIGKILL under live traffic — zero
+    dropped and zero mis-versioned responses."""
+
+    def test_two_replicas_swap_and_kill(self, binary_booster, tmp_path):
+        bst, X = binary_booster
+        art = PredictorArtifact.from_booster(bst)
+        retrain = _retrain_artifact(art, 1.75)
+        rows = X[:2]
+        expected = {
+            1: PackedPredictor(art).predict(rows),
+            2: PackedPredictor(retrain).predict(rows),
+        }
+        reg_dir = str(tmp_path / "reg")
+        ModelRegistry(reg_dir).publish(art)  # v1 pre-seeded
+
+        procs = _spawn_fleet(reg_dir, n=2)
+        proxy = FleetProxy(("127.0.0.1", 0),
+                           [f"127.0.0.1:{p}" for _, p in procs],
+                           health_poll_s=0.2, retry_deadline_s=20.0)
+        pt = threading.Thread(target=proxy.serve_forever, daemon=True)
+        pt.start()
+        port = proxy.server_address[1]
+        try:
+            threads, stats = _closed_loop(port, rows, expected,
+                                          duration_s=6.0)
+            time.sleep(1.0)
+            # hot swap the whole fleet through the proxy: one replica
+            # publishes + swaps, the other follows via the registry poll
+            r = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/models",
+                data=_artifact_bytes(retrain), timeout=60)
+            assert json.loads(r.read())["version"] == 2
+            time.sleep(1.0)
+            # SIGKILL one replica mid-traffic
+            procs[0][0].send_signal(signal.SIGKILL)
+            for t in threads:
+                t.join(timeout=60)
+            assert stats["errors"] == [], stats["errors"][:5]
+            assert stats["n"] > 0
+            assert 2 in stats["versions"], "swap never reached traffic"
+            # the survivor must be on v2
+            st = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{procs[1][1]}/stats", timeout=30).read())
+            assert st["model_version"] == 2
+        finally:
+            proxy.shutdown()
+            proxy.server_close()
+            for p, _ in procs:
+                p.kill()
+                p.wait(timeout=30)
+
+
+@pytest.mark.fleet
+@pytest.mark.slow
+class TestFleetLoad:
+    """Closed-loop load test: sustained traffic over 3 replicas through
+    the proxy while models hot-swap repeatedly and a replica is
+    SIGKILLed — zero dropped responses, zero mis-versioned replies, and
+    a bounded p99."""
+
+    def test_closed_loop_under_churn(self, binary_booster, tmp_path):
+        bst, X = binary_booster
+        art = PredictorArtifact.from_booster(bst)
+        rows = X[:4]
+        retrains = {v: _retrain_artifact(art, 1.0 + 0.25 * (v - 1))
+                    for v in range(2, 5)}
+        expected = {1: PackedPredictor(art).predict(rows)}
+        for v, a in retrains.items():
+            expected[v] = PackedPredictor(a).predict(rows)
+        reg_dir = str(tmp_path / "reg")
+        ModelRegistry(reg_dir).publish(art)
+
+        procs = _spawn_fleet(reg_dir, n=3)
+        proxy = FleetProxy(("127.0.0.1", 0),
+                           [f"127.0.0.1:{p}" for _, p in procs],
+                           health_poll_s=0.2, retry_deadline_s=30.0)
+        pt = threading.Thread(target=proxy.serve_forever, daemon=True)
+        pt.start()
+        port = proxy.server_address[1]
+        try:
+            threads, stats = _closed_loop(port, rows, expected,
+                                          duration_s=15.0, n_threads=8)
+            time.sleep(1.5)
+            for v, a in sorted(retrains.items()):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/models",
+                    data=_artifact_bytes(a), timeout=60)
+                time.sleep(1.5)
+            procs[0][0].send_signal(signal.SIGKILL)
+            for t in threads:
+                t.join(timeout=120)
+            assert stats["errors"] == [], stats["errors"][:5]
+            assert stats["n"] > 50
+            assert max(stats["versions"]) == 4
+            lat = sorted(stats["lat"])
+            p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+            # generous CI bound: the point is that retries + swaps keep
+            # latency bounded, not a hardware-grade SLO
+            assert p99 < 30.0, f"p99 {p99:.2f}s under churn"
+        finally:
+            proxy.shutdown()
+            proxy.server_close()
+            for p, _ in procs:
+                p.kill()
+                p.wait(timeout=30)

@@ -143,6 +143,10 @@ def live_server():
                     ds, num_boost_round=10, verbose_eval=False)
     path = tempfile.mktemp(suffix=".txt")
     bst.save_model(path)
+    # the registry counters are process-global; earlier in-process serve
+    # traffic (e.g. tests/test_fleet.py) would skew the /stats parity
+    # assertions, which compare against THIS server's batchers only
+    registry._reset_for_tests()
     srv = make_server(path, port=0, warmup_max_rows=256, max_delay_ms=1.0)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()

@@ -294,6 +294,27 @@ class TestRetraceDetector:
         assert w.compiles == 2 and w.retraces == 0
         assert len(w._sigs) == 2
 
+    def test_cleared_cache_rewarm_is_not_a_retrace(self):
+        """jax.clear_caches() empties every jit cache but the watch's
+        seen-signature set used to survive it, so the re-warm of each
+        already-seen signature was falsely flagged as a retrace (first
+        seen as test-order pollution: a module clearing caches between
+        two serve modules sharing model shapes).  A shrunken cache must
+        reset the seen set."""
+        import jax
+        import jax.numpy as jnp
+
+        fn = jax.jit(lambda x, mode: x * mode, static_argnames=("mode",))
+        w = JitWatch(fn, name="test.cleared")
+        x = jnp.ones((4,))
+        w(x, mode=2)
+        assert w.compiles == 1 and w.retraces == 0
+        jax.clear_caches()
+        w(x, mode=2)  # legitimate recompile of a seen signature
+        assert w.compiles == 2 and w.retraces == 0
+        w(x, mode=3)  # real hidden retrace still detected after a clear
+        assert w.retraces == 1
+
     def test_levelgrow_env_participates_in_program_identity(self,
                                                             monkeypatch):
         """Satellite regression: LIGHTGBM_TPU_LEVELGROW is read at

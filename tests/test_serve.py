@@ -156,6 +156,41 @@ class TestArtifact:
         with pytest.raises(LightGBMError, match="format_version"):
             PredictorArtifact.load(p)
 
+    def test_load_rejects_corrupt_file(self, tmp_path):
+        """Satellite 1: garbage bytes get the actionable refusal, not a
+        raw numpy/zipfile error."""
+        p = str(tmp_path / "corrupt.npz")
+        with open(p, "wb") as f:
+            f.write(b"this is not an npz archive at all")
+        with pytest.raises(LightGBMError,
+                           match="corrupt, truncated, or not an artifact"):
+            PredictorArtifact.load(p)
+
+    def test_load_rejects_truncated_file(self, binary_booster, tmp_path):
+        bst, _ = binary_booster
+        path = PredictorArtifact.from_booster(bst).save(str(tmp_path / "t"))
+        with open(path, "rb") as f:
+            blob = f.read()
+        p = str(tmp_path / "trunc.npz")
+        with open(p, "wb") as f:
+            f.write(blob[: len(blob) // 2])
+        with pytest.raises(LightGBMError):
+            PredictorArtifact.load(p)
+
+    def test_load_bytes_roundtrip_and_refusal(self, binary_booster):
+        import io
+
+        bst, X = binary_booster
+        art = PredictorArtifact.from_booster(bst)
+        buf = io.BytesIO()
+        art.save_to_bytes(buf)
+        loaded = PredictorArtifact.load_bytes(buf.getvalue())
+        assert loaded.meta == art.meta
+        assert np.array_equal(
+            PackedPredictor(loaded).predict(X[:8]), bst.predict(X[:8]))
+        with pytest.raises(LightGBMError, match="corrupt or truncated"):
+            PredictorArtifact.load_bytes(b"\x00\x01junk")
+
     def test_num_iteration_subset(self, binary_booster, tmp_path):
         bst, X = binary_booster
         art = PredictorArtifact.from_booster(bst, num_iteration=5)
@@ -319,6 +354,68 @@ class TestMicroBatcher:
         finally:
             mb.close()
 
+    def test_submit_ex_surfaces_batch_info(self):
+        """A predict_fn returning (outputs, info) stamps every request
+        of the batch with that info (the model-version attribution
+        channel); plain predict_fns surface info=None."""
+        mb = MicroBatcher(lambda b: (np.arange(b.shape[0]) * 2.0, 7),
+                          max_delay_ms=1)
+        try:
+            out, info = mb.submit_ex(np.zeros((3, 2)))
+            assert info == 7
+            assert np.array_equal(out, [0.0, 2.0, 4.0])
+            # plain submit() still returns just the outputs
+            assert np.array_equal(mb.submit(np.zeros((2, 2))), [0.0, 2.0])
+        finally:
+            mb.close()
+        mb2 = MicroBatcher(lambda b: np.zeros(b.shape[0]), max_delay_ms=1)
+        try:
+            _, info = mb2.submit_ex(np.zeros((1, 2)))
+            assert info is None
+        finally:
+            mb2.close()
+
+    def test_drain_settles_to_zero_and_sheds(self):
+        """Satellite 2 at the batcher level: drain() sheds new submits,
+        finishes queued+executing rows, then settles inflight_rows and
+        draining to a stable zero."""
+        import time as _time
+
+        gate = threading.Event()
+
+        def predict(batch):
+            gate.wait(5.0)
+            return np.zeros(batch.shape[0])
+
+        mb = MicroBatcher(predict, max_batch_size=4, max_delay_ms=1)
+        try:
+            t = threading.Thread(
+                target=lambda: mb.submit(np.zeros((2, 3)), timeout_ms=10_000),
+                daemon=True)
+            t.start()
+            _time.sleep(0.1)
+            assert mb.stats()["inflight_rows"] > 0
+            done = {}
+
+            def drainer():
+                done["ok"] = mb.drain(5.0)
+
+            dt = threading.Thread(target=drainer, daemon=True)
+            dt.start()
+            _time.sleep(0.05)
+            with pytest.raises(ServerOverloaded, match="draining"):
+                mb.submit(np.zeros((1, 3)))
+            gate.set()
+            dt.join(timeout=10)
+            t.join(timeout=10)
+            assert done["ok"] is True
+            st = mb.stats()
+            assert st["inflight_rows"] == 0
+            assert st["draining"] is False
+        finally:
+            gate.set()
+            mb.close()
+
 
 class TestHTTPServer:
     @pytest.fixture()
@@ -369,6 +466,24 @@ class TestHTTPServer:
         assert st["num_features"] == 12
         assert st["batcher"]["requests"] >= 1
         assert st["compiles"]["predict_retraces"] == 0
+
+    def test_model_version_stamping(self, server):
+        """Every predict reply names the model version that produced it:
+        X-Model-Version header always, per-line dicts on request."""
+        srv, bst, X = server
+        port = srv.server_address[1]
+        body = "\n".join(
+            json.dumps(list(map(float, r))) for r in X[:3]).encode()
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/predict", data=body, timeout=30)
+        assert r.headers["X-Model-Version"] == "1"
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/predict?model_version=1",
+            data=body, timeout=30)
+        lines = [json.loads(l) for l in r.read().decode().splitlines()]
+        assert all(l["model_version"] == 1 for l in lines)
+        assert np.array_equal(
+            np.asarray([l["prediction"] for l in lines]), bst.predict(X[:3]))
 
     def test_bad_requests(self, server):
         srv, _, _ = server
@@ -520,6 +635,37 @@ class TestReadyAndDrain:
             drainer.join(timeout=10)
             thread.join(timeout=10)
             assert not thread.is_alive()
-            assert srv.draining is True
+            # a COMPLETED drain settles: drained latches, draining (and
+            # every inflight count) reads a stable zero — not stuck at 1
+            assert srv.drained is True
+            assert srv.draining is False
+            assert srv._inflight == 0
+            assert srv.batcher.stats()["inflight_rows"] == 0
+            assert srv.batcher.stats()["draining"] is False
+        finally:
+            srv.server_close()
+
+    def test_drain_settles_metrics_gauges(self, binary_booster, tmp_path):
+        """Satellite 2: after a completed drain the Prometheus gauges —
+        not just /stats — read zero for draining and inflight (they are
+        fn-backed, so this checks the live server state they sample)."""
+        from lightgbm_tpu.obs.metrics import registry as metrics_registry
+        from lightgbm_tpu.serve.server import make_server
+
+        bst, X = binary_booster
+        path = PredictorArtifact.from_booster(bst).save(str(tmp_path / "m3"))
+        srv = make_server(path, port=0, warmup_max_rows=64, max_delay_ms=1.0)
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        port = srv.server_address[1]
+        try:
+            body = json.dumps(list(map(float, X[0]))).encode()
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/predict", data=body, timeout=30)
+            assert srv.drain(5.0) is True
+            snap = metrics_registry.snapshot()
+            assert snap["lightgbm_tpu_serve_draining"] == 0.0
+            assert snap["lightgbm_tpu_serve_inflight_requests"] == 0.0
+            assert snap["lightgbm_tpu_serve_queue_rows"] == 0.0
         finally:
             srv.server_close()
