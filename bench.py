@@ -166,6 +166,44 @@ def apply_regression_gate(out: dict, bench_dir: str = None, env=None) -> int:
                 if float(val_s) > thr_s:
                     out["regression_swap"] = True
                     rc = 1
+    # factory leg (independent): the append->promoted e2e latency gates
+    # against priors at the same (rows, num_boost_round) grid.  Wider
+    # 1.5x threshold: the cycle is host work (staging, eval, registry
+    # I/O) whose run-to-run variance dwarfs the s/iter legs'
+    fa = out.get("factory") or {}
+    val_f = fa.get("append_to_promoted_s")
+    if isinstance(val_f, (int, float)) and val_f > 0 and not fa.get("error"):
+        key_f = (fa.get("rows"), fa.get("num_boost_round"))
+        best_f, src_f = None, None
+        for path in sorted(glob.glob(os.path.join(bench_dir,
+                                                  "BENCH_r*.json"))):
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue
+            parsed = doc.get("parsed") if isinstance(doc, dict) else None
+            if not isinstance(parsed, dict):
+                parsed = doc if isinstance(doc, dict) else {}
+            if parsed.get("backend_fallback"):
+                continue
+            pf = parsed.get("factory") or {}
+            if (pf.get("rows"), pf.get("num_boost_round")) != key_f:
+                continue
+            pv = pf.get("append_to_promoted_s")
+            if isinstance(pv, (int, float)) and pv > 0 and (
+                    best_f is None or pv < best_f):
+                best_f, src_f = float(pv), os.path.basename(path)
+        if best_f is not None:
+            thr_f = best_f * 1.5
+            out["gate_factory"] = {
+                "best_prior_append_to_promoted_s": round(best_f, 3),
+                "best_prior_source": src_f,
+                "threshold_s": round(thr_f, 3),
+            }
+            if float(val_f) > thr_f:
+                out["regression_factory"] = True
+                rc = 1
     return rc
 
 
@@ -472,6 +510,114 @@ def _bench_ooc(X, y, base_params):
         }
     except Exception as e:  # pragma: no cover — ooc must not kill bench
         section["error"] = f"{type(e).__name__}: {e}"
+    return section
+
+
+def _bench_factory(X, y):
+    """Continuous-training factory benchmark (docs/FACTORY.md): the
+    append->promoted end-to-end latency of one warm-started cycle
+    (canary off — the watcher/retrain/publish/promote path itself), the
+    warm-start cost against a tree-count-matched cold retrain over the
+    same data, and the canary-window plumbing overhead (replica spawn +
+    bounded observation window + teardown, measured against an idle
+    proxy with min_requests=0).  BENCH_FACTORY=0 skips;
+    BENCH_FACTORY_ROWS resizes."""
+    import shutil
+    import tempfile
+    import threading
+
+    from lightgbm_tpu.factory import FactorySupervisor
+    from lightgbm_tpu.serve.fleet import FleetProxy, _free_ports
+
+    section = {}
+    rows = min(int(os.environ.get("BENCH_FACTORY_ROWS", 8_000)), len(X))
+    rounds = 10
+    knobs = {"num_boost_round": rounds, "checkpoint_freq": 5,
+             "debounce_ms": 0.0, "canary_fraction": 0.0}
+    params = {"objective": "binary", "num_leaves": 31, "verbose": -1,
+              "min_data_in_leaf": 5}
+    root = tempfile.mkdtemp(prefix="bench_factory_")
+
+    def write_chunk(data_dir, name, lo, hi):
+        path = os.path.join(data_dir, name)
+        with open(path, "a") as f:
+            np.savetxt(f, np.column_stack([y[lo:hi], X[lo:hi]]),
+                       fmt="%.6g", delimiter=",")
+        t = time.time() - 60  # out of the debounce window
+        os.utime(path, (t, t))
+
+    try:
+        data_dir = os.path.join(root, "data")
+        os.makedirs(data_dir)
+        write_chunk(data_dir, "chunk-000.csv", 0, rows // 2)
+        sup = FactorySupervisor(data_dir, os.path.join(root, "work"),
+                                os.path.join(root, "reg"),
+                                params=dict(params), **knobs)
+        t0 = time.time()
+        v1 = sup.run_cycle()
+        bootstrap_s = time.time() - t0
+        # the headline number: a chunk append -> warm retrain -> publish
+        # -> eval gate -> activate, end to end
+        write_chunk(data_dir, "chunk-001.csv", rows // 2, rows)
+        t0 = time.time()
+        v2 = sup.run_cycle()
+        warm_s = time.time() - t0
+        # cold control at the same final tree count (v1's rounds + the
+        # warm delta) over the same data — what skipping the warm start
+        # would have cost
+        cold = FactorySupervisor(data_dir, os.path.join(root, "work2"),
+                                 os.path.join(root, "reg2"),
+                                 params=dict(params),
+                                 **dict(knobs, num_boost_round=2 * rounds))
+        t0 = time.time()
+        vc = cold.run_cycle()
+        cold_s = time.time() - t0
+        section = {
+            "rows": rows,
+            "num_boost_round": rounds,
+            "bootstrap_cycle_s": round(bootstrap_s, 3),
+            "append_to_promoted_s": round(warm_s, 3),
+            "warm_start": bool(v2["warm_start"]),
+            "cold_equivalent_s": round(cold_s, 3),
+            "warm_vs_cold_speedup": round(cold_s / max(warm_s, 1e-9), 3),
+            "verdicts_ok": bool(
+                v1["verdict"] == v2["verdict"] == vc["verdict"]
+                == "promoted"),
+        }
+        # canary-window overhead: the same cycle shape with the canary
+        # plumbing live (pin-version replica spawn + observe window +
+        # teardown) against an idle proxy; min_requests=0 keeps the
+        # verdict a promote so the two latencies are comparable
+        if os.environ.get("BENCH_FACTORY_CANARY", "1") != "0":
+            # the proxy only serves its local /fleet/canary endpoint
+            # here; its one "backend" is a dead address no /predict ever
+            # routes through
+            proxy = FleetProxy(("127.0.0.1", 0),
+                               [f"127.0.0.1:{_free_ports(1)[0]}"],
+                               health_poll_s=0.5, retry_deadline_s=5.0)
+            threading.Thread(target=proxy.serve_forever,
+                             daemon=True).start()
+            try:
+                write_chunk(data_dir, "chunk-002.csv", 0, rows // 4)
+                csup = FactorySupervisor(
+                    data_dir, os.path.join(root, "work"),
+                    os.path.join(root, "reg"), params=dict(params),
+                    proxy=f"127.0.0.1:{proxy.server_address[1]}",
+                    **dict(knobs, canary_fraction=0.25, observe_s=1.0,
+                           min_requests=0))
+                t0 = time.time()
+                v3 = csup.run_cycle()
+                canary_s = time.time() - t0
+                section["canary_cycle_s"] = round(canary_s, 3)
+                section["canary_overhead_s"] = round(canary_s - warm_s, 3)
+                section["canary_verdict"] = v3["verdict"]
+            finally:
+                proxy.shutdown()
+                proxy.server_close()
+    except Exception as e:  # pragma: no cover — factory must not kill bench
+        section["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
     return section
 
 
@@ -968,6 +1114,12 @@ def main():
     # prefetch overlap, bounded residency — the chunk-streaming cost line
     if os.environ.get("BENCH_OOC", "0" if backend_fallback else "1") != "0":
         out["out_of_core"] = _bench_ooc(X, y, params)
+
+    # factory section (docs/FACTORY.md): append->promoted e2e latency of
+    # one warm-started continuous-training cycle, warm-start cost vs the
+    # tree-count-matched cold retrain, canary-window plumbing overhead
+    if os.environ.get("BENCH_FACTORY", "0" if backend_fallback else "1") != "0":
+        out["factory"] = _bench_factory(X, y)
 
     # kernel A/B section (docs/PERFORMANCE.md): the PR-6 kernel wins
     # measured head-to-head WITH parity checks — on a dead tunnel this is

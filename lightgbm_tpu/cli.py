@@ -279,6 +279,10 @@ def main(argv: List[str] = None) -> int:
         from .serve.fleet import main as fleet_main
 
         return fleet_main(argv[1:])
+    if argv and argv[0] == "factory":
+        from .factory.supervisor import main as factory_main
+
+        return factory_main(argv[1:])
     if argv and argv[0] == "ingest":
         # subcommand sugar for task=ingest (matches report/serve style)
         argv = ["task=ingest"] + argv[1:]

@@ -344,6 +344,27 @@ def _apply_init_model(booster: Booster, init_model, train_set: Dataset) -> None:
             model_str = f.read()
     prev = Booster(params=booster.params, model_str=model_str)
     b = booster.boosting
+    # schema-drift guard: a feature-count mismatch used to surface as a
+    # shape error deep in the trainer (or silent garbage predictions
+    # when the new data happens to be wider).  The continuous-training
+    # factory hits this whenever the watched data directory drifts, so
+    # name the mismatch and the fix here instead.
+    prev_nf = int(getattr(prev.boosting, "max_feature_idx", -1)) + 1
+    new_nf = int(train_set.num_feature())
+    if prev_nf > 0 and prev_nf != new_nf:
+        Log.fatal(
+            "init_model was trained on %d features but the new training "
+            "data has %d — continued training requires the same feature "
+            "schema (same columns, same order). Retrain from scratch, or "
+            "fix the data source that drifted.", prev_nf, new_nf)
+    prev_tpi = int(max(prev.boosting.num_tree_per_iteration, 1))
+    new_tpi = int(max(b.num_tree_per_iteration, 1))
+    if prev_tpi != new_tpi:
+        Log.fatal(
+            "init_model boosts %d tree(s) per iteration but the new "
+            "training config boosts %d (different objective/num_class?) "
+            "— continued training requires the same objective shape.",
+            prev_tpi, new_tpi)
     b.models = prev.boosting.models + b.models
     b.num_init_iteration = len(prev.boosting.models) // max(
         prev.boosting.num_tree_per_iteration, 1

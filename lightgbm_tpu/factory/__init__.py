@@ -1,0 +1,16 @@
+"""Continuous-training model factory (docs/FACTORY.md).
+
+``python -m lightgbm_tpu factory`` closes the loop the other
+subsystems left open: watch a data directory (factory/watch.py),
+warm-start an incremental retrain through the checkpointed engine,
+publish to the serving fleet's model registry, canary the candidate on
+a slice of live traffic, and auto-promote or auto-roll-back on the
+observed eval metric + serving SLO.  Supervisor state is an atomic
+CRC'd file (factory/state.py) so a kill anywhere restarts into the
+same run without double-publishing or losing a verdict.
+"""
+
+from .state import FactoryState
+from .supervisor import DEFAULTS, FactorySupervisor, main
+
+__all__ = ["FactoryState", "FactorySupervisor", "DEFAULTS", "main"]

@@ -1,0 +1,109 @@
+"""Crash-safe factory supervisor state.
+
+One JSON file in the factory workdir, written through the checkpoint
+store's atomic dance (tmp + fsync + rename + dir fsync) with a CRC32
+over the canonical payload bytes, so a reader never sees a torn or
+bit-rotten state and a kill at ANY instruction boundary leaves either
+the previous complete state or the new complete state.
+
+What must survive a kill (docs/FACTORY.md):
+
+- ``ingested``: the fingerprint manifest of data files already folded
+  into the promoted model — the watcher's "what changed?" baseline.
+- ``run``: the in-flight run record (run id, data fingerprint, stage,
+  candidate version).  A restart re-enters the SAME run; every stage is
+  idempotent (the retrain resumes from its checkpoint, the publish
+  dedupes on the run id, promote/quarantine are idempotent registry
+  writes), so re-driving the run after a kill converges instead of
+  duplicating work.
+- ``history``: bounded list of recorded verdicts — the audit trail a
+  rollback investigation starts from.
+- ``current``: the promoted model (version + model text path + eval
+  metric) that seeds the next warm-started retrain.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Dict, List, Optional
+
+from ..ckpt.store import _atomic_write
+from ..utils.log import Log
+
+STATE_FILE = "factory_state.json"
+HISTORY_KEEP = 50
+
+
+def _payload_crc(payload: Dict) -> int:
+    blob = json.dumps(payload, sort_keys=True).encode()
+    return zlib.crc32(blob) & 0xFFFFFFFF
+
+
+class FactoryState:
+    """In-memory view of the supervisor state + atomic save/load."""
+
+    def __init__(self, workdir: str):
+        self.workdir = workdir
+        self.path = os.path.join(workdir, STATE_FILE)
+        self.ingested: Dict[str, Dict] = {}
+        self.run: Optional[Dict] = None
+        self.history: List[Dict] = []
+        self.current: Optional[Dict] = None
+        self.retrain_seq = 0
+        self.last_run_ts = 0.0
+
+    # -- (de)serialization ---------------------------------------------
+    def _payload(self) -> Dict:
+        return {
+            "ingested": self.ingested,
+            "run": self.run,
+            "history": self.history,
+            "current": self.current,
+            "retrain_seq": int(self.retrain_seq),
+            "last_run_ts": float(self.last_run_ts),
+        }
+
+    def save(self) -> None:
+        payload = self._payload()
+        doc = {"crc32": _payload_crc(payload), "payload": payload}
+        _atomic_write(self.path, json.dumps(doc, indent=1).encode())
+
+    @classmethod
+    def load(cls, workdir: str) -> "FactoryState":
+        """Load the saved state, or a fresh one when absent.  A CRC
+        mismatch (disk corruption — atomic writes rule out torn files)
+        is refused loudly rather than silently starting over: the
+        operator decides whether to delete the file, and the registry's
+        publish dedupe means even a fresh start cannot double-publish."""
+        st = cls(workdir)
+        try:
+            with open(st.path) as f:
+                doc = json.load(f)
+        except FileNotFoundError:
+            return st
+        except (OSError, ValueError) as e:
+            Log.fatal("factory: unreadable state file %s (%s) — delete it "
+                      "to start fresh (publishes are deduped, so no "
+                      "double-publish can result)", st.path, e)
+        payload = doc.get("payload")
+        if not isinstance(payload, dict) or (
+                _payload_crc(payload) != int(doc.get("crc32", -1))):
+            Log.fatal("factory: state file %s fails its CRC — the file is "
+                      "corrupt; delete it to start fresh (publishes are "
+                      "deduped, so no double-publish can result)", st.path)
+        st.ingested = dict(payload.get("ingested") or {})
+        st.run = payload.get("run") or None
+        st.history = list(payload.get("history") or [])
+        st.current = payload.get("current") or None
+        st.retrain_seq = int(payload.get("retrain_seq") or 0)
+        st.last_run_ts = float(payload.get("last_run_ts") or 0.0)
+        return st
+
+    # -- verdict history -----------------------------------------------
+    def record_verdict(self, verdict: Dict,
+                       keep: int = HISTORY_KEEP) -> None:
+        self.history.append(verdict)
+        if len(self.history) > keep:
+            self.history = self.history[-keep:]

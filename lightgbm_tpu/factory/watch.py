@@ -1,0 +1,94 @@
+"""Data-directory watcher: mtime + content-fingerprint manifest.
+
+Poll-based (no inotify dependency, works on network mounts — the same
+reasoning as registry.watch_token).  A file's fingerprint is its size,
+mtime, and a CRC32 over its first and last 64 KiB: cheap enough to
+rescan every poll even for multi-GB chunks, and an APPEND to an
+existing file changes both size and tail CRC, so appended chunks
+retrain just like new files (the ISSUE-11 contract).
+
+Debounce: a change only counts once every watched file's mtime is at
+least ``debounce_s`` old — a writer mid-append never triggers a
+retrain on a half-written chunk.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import zlib
+from typing import Dict, List, Tuple
+
+_FP_CHUNK = 65536
+DATA_SUFFIXES: Tuple[str, ...] = (".csv", ".tsv", ".txt", ".data")
+
+
+def fingerprint(path: str) -> Dict:
+    st = os.stat(path)
+    crc = 0
+    with open(path, "rb") as f:
+        crc = zlib.crc32(f.read(_FP_CHUNK))
+        if st.st_size > 2 * _FP_CHUNK:
+            f.seek(-_FP_CHUNK, os.SEEK_END)
+            crc = zlib.crc32(f.read(_FP_CHUNK), crc)
+    return {
+        "size": int(st.st_size),
+        "mtime_ns": int(st.st_mtime_ns),
+        "crc32": crc & 0xFFFFFFFF,
+    }
+
+
+def scan(data_dir: str,
+         suffixes: Tuple[str, ...] = DATA_SUFFIXES) -> Dict[str, Dict]:
+    """{filename: fingerprint} for every data chunk in ``data_dir``,
+    sorted by name (chunk order = lexical order, the ingest convention).
+    Hidden files and non-data suffixes are ignored."""
+    out: Dict[str, Dict] = {}
+    try:
+        names = sorted(os.listdir(data_dir))
+    except OSError:
+        return out
+    for name in names:
+        if name.startswith("."):
+            continue
+        if suffixes and not name.endswith(suffixes):
+            continue
+        path = os.path.join(data_dir, name)
+        try:
+            if not os.path.isfile(path):
+                continue
+            out[name] = fingerprint(path)
+        except OSError:
+            continue  # vanished mid-scan; next poll sees the truth
+    return out
+
+
+def changed(prev: Dict[str, Dict], cur: Dict[str, Dict]) -> List[str]:
+    """Names that are new or whose content fingerprint moved (size or
+    CRC — mtime alone is NOT a change: a touch must not retrain)."""
+    out = []
+    for name, fp in cur.items():
+        old = prev.get(name)
+        if old is None or old["size"] != fp["size"] \
+                or old["crc32"] != fp["crc32"]:
+            out.append(name)
+    return out
+
+
+def stable(cur: Dict[str, Dict], debounce_s: float) -> bool:
+    """True once every watched file's mtime is at least ``debounce_s``
+    old — the writer finished appending."""
+    now = time.time()
+    return all(now - fp["mtime_ns"] / 1e9 >= debounce_s
+               for fp in cur.values())
+
+
+def combined_fingerprint(cur: Dict[str, Dict]) -> str:
+    """Order-stable fingerprint of the whole data set — the run id's
+    content half, so re-scanning unchanged data maps to the same run."""
+    crc = 0
+    for name in sorted(cur):
+        fp = cur[name]
+        crc = zlib.crc32(
+            f"{name}:{fp['size']}:{fp['crc32']}".encode(), crc)
+    return f"{crc & 0xFFFFFFFF:08x}"

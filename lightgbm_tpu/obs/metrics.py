@@ -166,6 +166,23 @@ class Histogram:
     def value(self) -> float:  # symmetry with counter/gauge (snapshot())
         return float(self._count)
 
+    def quantile(self, q: float) -> float:
+        """Smallest bucket upper bound covering fraction ``q`` of the
+        observations (0.0 when empty).  Bucket-resolution only — what
+        an SLO verdict needs, not a billing meter."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+        if total <= 0:
+            return 0.0
+        target = float(q) * total
+        acc = 0
+        for b, c in zip(self.buckets, counts):
+            acc += c
+            if acc >= target:
+                return float(b)
+        return float(self.buckets[-1])
+
     def samples(self) -> List[str]:
         with self._lock:
             counts = list(self._counts)
@@ -179,6 +196,64 @@ class Histogram:
         out.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
         out.append(f"{self.name}_sum {_fmt(s)}")
         out.append(f"{self.name}_count {total}")
+        return out
+
+
+class LabeledFamily:
+    """One metric family split by a single label — per-model-version
+    serving metrics (``requests{model_version="3"}``) without an
+    unbounded cardinality risk: children are created per label value and
+    ``prune()``'d back to the versions actually loaded after every swap.
+    Child samples are re-emitted with the label pair injected, merging
+    with any labels the child already carries (histogram ``le``)."""
+
+    def __init__(self, name: str, help: str = "", child_cls=Counter,
+                 label: str = "model_version", **kw):
+        self.name = name
+        self.help = help
+        self.cls = child_cls
+        self.kind = child_cls.kind
+        self.label = label
+        self._kw = kw
+        self._children: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, value) -> object:
+        key = str(value)
+        with self._lock:
+            c = self._children.get(key)
+            if c is None:
+                c = self.cls(self.name, self.help, **self._kw)
+                self._children[key] = c
+            return c
+
+    def children(self) -> Dict[str, object]:
+        with self._lock:
+            return dict(self._children)
+
+    def prune(self, keep) -> None:
+        """Drop children whose label value is not in ``keep`` — bounds
+        scrape cardinality to the versions currently loaded."""
+        keep = {str(k) for k in keep}
+        with self._lock:
+            for k in list(self._children):
+                if k not in keep:
+                    del self._children[k]
+
+    def value(self) -> float:
+        return sum(c.value() for c in self.children().values())
+
+    def samples(self) -> List[str]:
+        out: List[str] = []
+        for key, c in sorted(self.children().items()):
+            pair = f'{self.label}="{key}"'
+            for s in c.samples():
+                metric, val = s.rsplit(None, 1)
+                if "{" in metric:
+                    head, rest = metric.split("{", 1)
+                    out.append(f"{head}{{{pair},{rest} {val}")
+                else:
+                    out.append(f"{metric}{{{pair}}} {val}")
         return out
 
 
@@ -222,6 +297,19 @@ class MetricsRegistry:
     def histogram(self, name: str, help: str = "",
                   buckets: Sequence[float] = LATENCY_BUCKETS) -> Histogram:
         return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def labeled_counter(self, name: str, help: str = "",
+                        label: str = "model_version") -> LabeledFamily:
+        return self._get_or_create(LabeledFamily, name, help,
+                                   child_cls=Counter, label=label)
+
+    def labeled_histogram(self, name: str, help: str = "",
+                          label: str = "model_version",
+                          buckets: Sequence[float] = LATENCY_BUCKETS,
+                          ) -> LabeledFamily:
+        return self._get_or_create(LabeledFamily, name, help,
+                                   child_cls=Histogram, label=label,
+                                   buckets=buckets)
 
     # -- tracer mirror -------------------------------------------------
     def _mirror_target(self, n: str):
@@ -296,7 +384,10 @@ class MetricsRegistry:
         with self._lock:
             metrics = list(self._metrics.values())
         for m in metrics:
-            if isinstance(m, Histogram):
+            if isinstance(m, LabeledFamily):
+                with m._lock:
+                    m._children.clear()
+            elif isinstance(m, Histogram):
                 with m._lock:
                     m._counts = [0] * (len(m.buckets) + 1)
                     m._sum = 0.0

@@ -66,6 +66,9 @@ _M_PROXY_EJECTIONS = metrics_registry.counter(
 _M_PROXY_LATENCY = metrics_registry.histogram(
     "lightgbm_tpu_proxy_latency_seconds",
     "proxy request latency including retries", buckets=LATENCY_BUCKETS)
+_M_PROXY_CANARY = metrics_registry.counter(
+    "lightgbm_tpu_proxy_canary_requests_total",
+    "predict requests answered by the canary backend")
 
 
 # ----------------------------------------------------------------------
@@ -247,6 +250,13 @@ class FleetProxy(ThreadingHTTPServer):
         self._rr = 0
         self._stop = threading.Event()
         self.t_start = time.time()
+        # canary slice (docs/FACTORY.md): an out-of-rotation backend
+        # pinned to the candidate version; a deterministic fraction of
+        # /predict traffic is diverted to it, and a canary failure falls
+        # back into the main pool so the client never pays for it
+        self.canary: Optional[_Backend] = None
+        self.canary_fraction = 0.0
+        self._canary_tick = 0
         metrics_registry.gauge(
             "lightgbm_tpu_proxy_healthy_backends",
             "backends currently accepting traffic",
@@ -282,6 +292,39 @@ class FleetProxy(ThreadingHTTPServer):
             chosen.requests += 1
             return chosen
 
+    # -- canary slice --------------------------------------------------
+    def set_canary(self, addr: Optional[str],
+                   fraction: float = 0.0) -> None:
+        """Install (or clear with ``addr=None``/``fraction<=0``) the
+        canary backend receiving ``fraction`` of /predict traffic."""
+        with self._block:
+            if addr and fraction > 0:
+                self.canary = _Backend(addr)
+                self.canary_fraction = min(1.0, float(fraction))
+                self._canary_tick = 0
+            else:
+                self.canary = None
+                self.canary_fraction = 0.0
+        tracer.event("fleet.canary",
+                     addr=str(addr) if addr and fraction > 0 else None,
+                     fraction=float(self.canary_fraction))
+
+    def pick_canary(self) -> Optional[_Backend]:
+        """Deterministic fraction routing: predict request t diverts to
+        the canary exactly when ``floor(t*f)`` advances — fraction f of
+        traffic with no RNG and no burst (every 1/f-th request)."""
+        with self._block:
+            c = self.canary
+            if c is None or not c.healthy:
+                return None
+            self._canary_tick += 1
+            t, f = self._canary_tick, self.canary_fraction
+            if int(t * f) <= int((t - 1) * f):
+                return None
+            c.inflight += 1
+            c.requests += 1
+            return c
+
     def release(self, backend: _Backend) -> None:
         with self._block:
             backend.inflight = max(0, backend.inflight - 1)
@@ -313,7 +356,10 @@ class FleetProxy(ThreadingHTTPServer):
 
     def _health_loop(self) -> None:
         while not self._stop.wait(self.health_poll_s):
-            for b in self.backends:
+            with self._block:
+                c = self.canary
+            probed = list(self.backends) + ([c] if c is not None else [])
+            for b in probed:
                 ok = self._probe(b)
                 with self._block:
                     if ok and not b.healthy:
@@ -324,11 +370,15 @@ class FleetProxy(ThreadingHTTPServer):
     def stats(self) -> Dict:
         with self._block:
             backends = [b.as_dict() for b in self.backends]
+            canary = (dict(self.canary.as_dict(),
+                           fraction=self.canary_fraction)
+                      if self.canary is not None else None)
         return {
             "uptime_s": round(time.time() - self.t_start, 1),
             "policy": self.policy,
             "healthy": sum(1 for b in backends if b["healthy"]),
             "backends": backends,
+            "canary": canary,
         }
 
     def shutdown(self):
@@ -375,7 +425,28 @@ class _ProxyHandler(BaseHTTPRequestHandler):
     def do_POST(self):
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b""
+        if self.path == "/fleet/canary":
+            self._do_canary(body)
+            return
         self._forward("POST", body=body)
+
+    def _do_canary(self, body: bytes) -> None:
+        """POST /fleet/canary {"addr": "host:port", "fraction": 0.2} —
+        install a canary slice; null addr or fraction<=0 clears it."""
+        try:
+            req = json.loads(body.decode("utf-8") or "{}")
+            addr = req.get("addr")
+            fraction = float(req.get("fraction") or 0.0)
+        except (ValueError, AttributeError) as e:
+            self._reply_json(400, {"error": f"bad canary request: {e}"})
+            return
+        self.server.set_canary(addr, fraction)
+        with self.server._block:
+            c = self.server.canary
+            self._reply_json(200, {
+                "canary": c.addr if c is not None else None,
+                "fraction": self.server.canary_fraction,
+            })
 
     def _forward(self, method: str, body: Optional[bytes]) -> None:
         """Relay to a healthy backend; eject-and-retry on connection
@@ -386,6 +457,27 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         t0 = time.perf_counter()
         _M_PROXY_REQS.inc()
         deadline = time.monotonic() + srv.retry_deadline_s
+        if method == "POST" and self.path.partition("?")[0] == "/predict":
+            canary = srv.pick_canary()
+            if canary is not None:
+                status = None
+                try:
+                    status, headers, payload = self._try_backend(
+                        srv, canary, method, body)
+                except (OSError, http.client.HTTPException):
+                    pass
+                finally:
+                    srv.release(canary)
+                if status is not None and status < 500 and status != 503:
+                    _M_PROXY_CANARY.inc()
+                    _M_PROXY_LATENCY.observe(time.perf_counter() - t0)
+                    self._reply(status, payload, headers=headers)
+                    return
+                # a failing canary never costs the client a response:
+                # fall back into the main pool.  The canary replica's
+                # own per-version error metrics carry the verdict
+                # evidence — the proxy only limits the blast radius.
+                _M_PROXY_RETRIES.inc()
         tried_this_round: set = set()
         unavailable_503 = 0
         attempt = 0

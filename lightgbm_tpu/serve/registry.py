@@ -10,8 +10,22 @@ processes share:
     v00000001.npz     packed PredictorArtifact, immutable once published
     v00000002.npz
     MANIFEST.json     {"entries": {name: {version, crc32, size, ts,
-                       num_trees, num_features, ...}},
-                       "active_version": int|null}
+                       num_trees, num_features, ...,
+                       dedupe_key?, quarantined?}},
+                       "active_version": int|null,
+                       "canary_version": int|null}
+
+Lifecycle state beyond "active" (the continuous-training factory,
+docs/FACTORY.md): ``canary_version`` marks a version under canary
+evaluation — retention must not collect the model a canary replica is
+serving, however slow the observation window.  ``quarantine(version,
+reason)`` records a failed canary verdict on the entry; a quarantined
+version is never re-activated by the factory and the most recently
+quarantined one survives retention as evidence.  ``publish_bytes``
+accepts a ``dedupe_key``: re-publishing the same key returns the
+already-claimed version instead of minting a new one, which makes a
+crash between publish and the publisher's own state write idempotent
+(kill-anywhere restart never double-publishes).
 
 Write protocol (the ckpt/store.py atomic dance, reused literally):
 artifact bytes -> tmp + fsync -> hardlink-claim of the next free
@@ -124,24 +138,26 @@ class ModelRegistry:
             with open(self._manifest_path()) as f:
                 m = json.load(f)
             if isinstance(m, dict) and isinstance(m.get("entries"), dict):
+                m.setdefault("canary_version", None)
                 return m
         except (OSError, ValueError):
             pass
-        return {"entries": {}, "active_version": None}
+        return {"entries": {}, "active_version": None, "canary_version": None}
 
     def _write_manifest(self, manifest: Dict) -> None:
         _atomic_write(self._manifest_path(),
                       json.dumps(manifest, indent=1).encode())
 
     # -- publish -------------------------------------------------------
-    def publish(self, artifact: PredictorArtifact,
-                activate: bool = True) -> int:
+    def publish(self, artifact: PredictorArtifact, activate: bool = True,
+                dedupe_key: Optional[str] = None) -> int:
         """Publish a validated in-memory artifact; returns its version."""
         import io
 
         buf = io.BytesIO()
         artifact.save_to_bytes(buf)
         return self.publish_bytes(buf.getvalue(), activate=activate,
+                                  dedupe_key=dedupe_key,
                                   _validated_meta=dict(artifact.meta))
 
     def publish_file(self, path: str, activate: bool = True) -> int:
@@ -162,12 +178,16 @@ class ModelRegistry:
                                   _only_if_empty=True)
 
     def publish_bytes(self, blob: bytes, activate: bool = True,
+                      dedupe_key: Optional[str] = None,
                       _validated_meta: Optional[Dict] = None,
                       _only_if_empty: bool = False) -> int:
         """Publish raw ``.npz`` artifact bytes (the ``POST /models``
         body).  The blob is fully validated through
         ``PredictorArtifact.load`` BEFORE it can claim a version — a
-        corrupt upload never enters the manifest."""
+        corrupt upload never enters the manifest.  With ``dedupe_key``
+        a key already present in the manifest short-circuits to its
+        version: a publisher killed between publish and its own durable
+        state write retries idempotently instead of double-publishing."""
         meta = _validated_meta
         if meta is None:
             meta = dict(PredictorArtifact.load_bytes(blob).meta)
@@ -185,6 +205,10 @@ class ModelRegistry:
                         return int(active)
                     return max(int(e["version"])
                                for e in manifest["entries"].values())
+                if dedupe_key is not None:
+                    for e in manifest["entries"].values():
+                        if e.get("dedupe_key") == dedupe_key:
+                            return int(e["version"])
                 version = self._next_version(manifest)
                 path = os.path.join(self.dir, _version_name(version))
                 # hardlink-claim: fails loudly if the name exists (a
@@ -201,6 +225,9 @@ class ModelRegistry:
                     "num_class": int(meta.get("num_class", 1)),
                     "objective": str(meta.get("objective", "")),
                 }
+                if dedupe_key is not None:
+                    manifest["entries"][os.path.basename(path)][
+                        "dedupe_key"] = str(dedupe_key)
                 if activate:
                     manifest["active_version"] = version
                 self._gc(manifest)
@@ -249,17 +276,85 @@ class ModelRegistry:
             manifest["active_version"] = int(version)
             self._write_manifest(manifest)
 
+    # -- canary / quarantine lifecycle (docs/FACTORY.md) ---------------
+    def set_canary(self, version: Optional[int]) -> None:
+        """Mark ``version`` as under canary evaluation (``None`` clears).
+        A canary version is retention-protected for the whole
+        observation window — GC must never collect the model the canary
+        replica is pinned to."""
+        with _PublishLock(self.dir):
+            manifest = self.read_manifest()
+            if version is not None and not any(
+                    int(e["version"]) == int(version)
+                    for e in manifest["entries"].values()):
+                Log.fatal("registry: cannot canary unknown version %s "
+                          "(published: %s)", version,
+                          sorted(int(e["version"])
+                                 for e in manifest["entries"].values()))
+            manifest["canary_version"] = (
+                int(version) if version is not None else None)
+            self._write_manifest(manifest)
+
+    def clear_canary(self) -> None:
+        self.set_canary(None)
+
+    def canary_version(self) -> Optional[int]:
+        v = self.read_manifest().get("canary_version")
+        return int(v) if v is not None else None
+
+    def quarantine(self, version: int, reason: str) -> None:
+        """Record a failed canary verdict on a published version.  A
+        quarantined version keeps its artifact (the most recent one is
+        retention-protected as evidence) but the factory never
+        re-activates it; the reason string is the audit trail."""
+        with _PublishLock(self.dir):
+            manifest = self.read_manifest()
+            entry = None
+            for e in manifest["entries"].values():
+                if int(e["version"]) == int(version):
+                    entry = e
+                    break
+            if entry is None:
+                Log.fatal("registry: cannot quarantine unknown version %s "
+                          "(published: %s)", version,
+                          sorted(int(e["version"])
+                                 for e in manifest["entries"].values()))
+            entry["quarantined"] = str(reason)
+            if manifest.get("canary_version") == int(version):
+                manifest["canary_version"] = None
+            self._write_manifest(manifest)
+        from ..obs import tracer
+
+        tracer.event("registry.quarantined", version=int(version),
+                     reason=str(reason))
+
+    def quarantined(self) -> Dict[int, str]:
+        """{version: reason} for every quarantined entry."""
+        return {int(e["version"]): str(e["quarantined"])
+                for e in self.read_manifest()["entries"].values()
+                if e.get("quarantined")}
+
     def _gc(self, manifest: Dict) -> None:
         if self.keep_last <= 0:
             return
         entries = manifest["entries"]
-        active = manifest.get("active_version")
+        # retention protects everything a process may still be serving
+        # or a human may still need: the active version (replicas drain
+        # onto it), the canary version (a slow observation window must
+        # not lose the model under evaluation), and the most recently
+        # quarantined version (the rollback evidence)
+        protected = {manifest.get("active_version"),
+                     manifest.get("canary_version")}
+        quarantined = [int(e["version"]) for e in entries.values()
+                       if e.get("quarantined")]
+        if quarantined:
+            protected.add(max(quarantined))
         versions = sorted((int(e["version"]), name)
                           for name, e in entries.items())
         while len(versions) > self.keep_last:
             v, name = versions.pop(0)
-            if v == active:
-                continue  # never collect what replicas are serving
+            if v in protected:
+                continue
             entries.pop(name, None)
             try:
                 os.unlink(os.path.join(self.dir, name))
@@ -268,15 +363,20 @@ class ModelRegistry:
 
     # -- read side -----------------------------------------------------
     def list_models(self) -> List[Dict]:
-        """Manifest entries, oldest first, with the active flag set."""
+        """Manifest entries, oldest first, with lifecycle flags set."""
         manifest = self.read_manifest()
         active = manifest.get("active_version")
+        canary = manifest.get("canary_version")
         out = []
         for name, e in sorted(manifest["entries"].items(),
                               key=lambda kv: int(kv[1]["version"])):
             row = dict(e)
             row["name"] = name
             row["active"] = int(e["version"]) == active if active else False
+            row["canary"] = (int(e["version"]) == canary
+                             if canary is not None else False)
+            row["quarantined"] = str(e["quarantined"]) \
+                if e.get("quarantined") else None
             out.append(row)
         return out
 

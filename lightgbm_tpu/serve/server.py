@@ -89,7 +89,23 @@ DEFAULTS = {
     "shard": 0,
     "drain_timeout_ms": 10000,
     "registry_poll_ms": 500.0,
+    "pin_version": 0,
 }
+
+# per-version serving attribution (docs/FACTORY.md): one labeled child
+# per model version currently loaded — the canary verdict's scrape
+# surface.  Families are pruned back to the live version after every
+# completed swap, so label cardinality stays bounded by the versions
+# this replica is actually serving.
+_M_VER_REQS = metrics_registry.labeled_counter(
+    "lightgbm_tpu_serve_version_requests_total",
+    "predict requests answered, split by serving model version")
+_M_VER_ERRS = metrics_registry.labeled_counter(
+    "lightgbm_tpu_serve_version_errors_total",
+    "failed predict requests (500/503/504), split by model version")
+_M_VER_LATENCY = metrics_registry.labeled_histogram(
+    "lightgbm_tpu_serve_version_latency_seconds",
+    "predict request latency, split by serving model version")
 
 
 def load_artifact(model_path: str) -> PredictorArtifact:
@@ -152,8 +168,12 @@ class PredictServer(ThreadingHTTPServer):
                  batcher_opts: Optional[Dict] = None,
                  registry: Optional[ModelRegistry] = None,
                  registry_poll_ms: float = 500.0,
-                 warmup_max_rows: int = 4096, do_warmup: bool = True):
+                 warmup_max_rows: int = 4096, do_warmup: bool = True,
+                 pin_version: Optional[int] = None):
         self.predictor = predictor
+        # pinned replicas (canary) serve exactly one version: no
+        # watcher, and maybe_swap is a no-op even on POST /models
+        self.pin_version = int(pin_version) if pin_version else None
         opts = dict(batcher_opts or {})
         self.batcher = MicroBatcher(
             lambda batch: predictor.predict(batch),
@@ -220,21 +240,27 @@ class PredictServer(ThreadingHTTPServer):
         the one serving.  Serialized so the watcher thread and a POST
         /models handler cannot double-load; returns the swap stats, or
         None when already current (or not in registry mode)."""
-        if self.registry is None:
+        if self.registry is None or self.pin_version is not None:
             return None
         with self._swap_lock:
             target = self.registry.active_version()
             if target is None or target == self.predictor.version:
                 return None
             artifact = self.registry.load(target)
-            return self.predictor.swap_to(
+            stats = self.predictor.swap_to(
                 artifact, target, warmup_max_rows=self._warmup_max_rows,
                 do_warmup=self._do_warmup)
+            # swap_to returned => the old version finished draining; its
+            # labeled children would otherwise accumulate forever
+            for fam in (_M_VER_REQS, _M_VER_ERRS, _M_VER_LATENCY):
+                fam.prune({str(target)})
+            return stats
 
     def start_registry_watcher(self) -> None:
         """Poll the registry's change token and swap on activation —
         inotify-free, so it works on any shared filesystem."""
-        if self.registry is None or self._watch_thread is not None:
+        if (self.registry is None or self.pin_version is not None
+                or self._watch_thread is not None):
             return
         poll_s = max(self.registry_poll_ms, 1.0) / 1e3
 
@@ -302,6 +328,30 @@ class PredictServer(ThreadingHTTPServer):
         self.drained = True
         return drained
 
+    def version_stats(self) -> Dict[str, Dict]:
+        """Per-version serving attribution — the JSON parity view of the
+        labeled ``/metrics`` families (same counters, same histogram).
+        This is what the factory's canary observer polls for its SLO
+        verdict."""
+        out: Dict[str, Dict] = {}
+        lat = _M_VER_LATENCY.children()
+        errs = _M_VER_ERRS.children()
+        for v, c in _M_VER_REQS.children().items():
+            h = lat.get(v)
+            out[v] = {
+                "requests": int(c.value()),
+                "errors": int(errs[v].value()) if v in errs else 0,
+                "latency_p50_ms":
+                    round(h.quantile(0.5) * 1e3, 3) if h else 0.0,
+                "latency_p99_ms":
+                    round(h.quantile(0.99) * 1e3, 3) if h else 0.0,
+            }
+        for v, c in errs.items():
+            if v not in out:
+                out[v] = {"requests": 0, "errors": int(c.value()),
+                          "latency_p50_ms": 0.0, "latency_p99_ms": 0.0}
+        return out
+
     def stats(self) -> Dict:
         cw = compilewatch.snapshot()
         watched = cw["watched"].get("serve.predict_raw", {})
@@ -314,6 +364,8 @@ class PredictServer(ThreadingHTTPServer):
             "num_features": self.predictor.num_features,
             "num_class": self.predictor.artifact.num_class,
             "model_version": getattr(self.predictor, "version", None),
+            "pin_version": self.pin_version,
+            "per_version": self.version_stats(),
             "batcher": self.batcher.stats(),
             "raw_batcher": self.raw_batcher.stats(),
             "compiles": {
@@ -449,6 +501,12 @@ class _Handler(BaseHTTPRequestHandler):
             "swap": swap,
         })
 
+    def _count_error(self) -> None:
+        # a failed request never reached a batch, so it is attributed
+        # to the version currently serving
+        _M_VER_ERRS.labels(
+            getattr(self.server.predictor, "version", 0)).inc()
+
     def _do_predict(self, query: str) -> None:
         raw_score = "raw_score=1" in query
         stamp_version = "model_version=1" in query
@@ -459,18 +517,26 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply_json(400, {"error": str(e)})
             return
         batcher = self.server.raw_batcher if raw_score else self.server.batcher
+        t0 = time.monotonic()
         try:
             preds, version = batcher.submit_ex(rows)
         except ServerOverloaded as e:
+            self._count_error()
             self._reply_json(503, {"error": str(e)})
             return
         except RequestTimeout as e:
+            self._count_error()
             self._reply_json(504, {"error": str(e)})
             return
         except Exception as e:
             Log.warning("serve: predict failed: %s", e)
+            self._count_error()
             self._reply_json(500, {"error": f"{type(e).__name__}: {e}"})
             return
+        # attribute the request to the ONE version that answered it —
+        # the same version the X-Model-Version header carries
+        _M_VER_REQS.labels(version).inc()
+        _M_VER_LATENCY.labels(version).observe(time.monotonic() - t0)
 
         def _plain(p):
             return p.tolist() if isinstance(p, np.ndarray) else float(p)
@@ -492,22 +558,31 @@ def make_server(model_path: Optional[str] = None, host: str = "127.0.0.1",
                 shard: bool = False, do_warmup: bool = True,
                 registry_dir: Optional[str] = None,
                 registry_poll_ms: float = 500.0,
+                pin_version: Optional[int] = None,
                 **batcher_opts) -> PredictServer:
     """Build (and optionally warm) a ready-to-run server; ``port=0``
     binds an ephemeral port (tests).  With ``registry_dir`` the server
     serves the registry's active version and hot-swaps on activation;
-    an empty registry is seeded from ``model_path``."""
+    an empty registry is seeded from ``model_path``.  ``pin_version``
+    (registry mode) serves exactly that published version and never
+    swaps — the factory's canary replica."""
     registry = ModelRegistry(registry_dir) if registry_dir else None
     version = 1
     if registry is not None:
-        if registry.active_version() is None:
-            if not model_path:
-                Log.fatal("serve: registry %s is empty and no model= was "
-                          "given to seed it", registry_dir)
-            # lock-guarded: N replicas racing to seed the same shared
-            # registry publish exactly one v1
-            registry.seed(load_artifact(model_path))
-        version, artifact = registry.load_active()
+        if pin_version:
+            # canary replica: serve exactly this version, ignore
+            # activations — promotion/rollback happens around us
+            version = int(pin_version)
+            artifact = registry.load(version)
+        else:
+            if registry.active_version() is None:
+                if not model_path:
+                    Log.fatal("serve: registry %s is empty and no model= "
+                              "was given to seed it", registry_dir)
+                # lock-guarded: N replicas racing to seed the same shared
+                # registry publish exactly one v1
+                registry.seed(load_artifact(model_path))
+            version, artifact = registry.load_active()
         predictor = make_predictor(artifact, shard=shard)
     else:
         if not model_path:
@@ -519,7 +594,8 @@ def make_server(model_path: Optional[str] = None, host: str = "127.0.0.1",
                            registry=registry,
                            registry_poll_ms=registry_poll_ms,
                            warmup_max_rows=warmup_max_rows,
-                           do_warmup=do_warmup)
+                           do_warmup=do_warmup,
+                           pin_version=pin_version)
     if do_warmup:
         stats = swapper.warmup(warmup_max_rows)
         Log.info("serve: warmup compiled %d programs over buckets %s in %.2fs",
@@ -555,6 +631,7 @@ def main(argv: List[str]) -> int:
         do_warmup=bool(opts["warmup"]),
         registry_dir=registry_dir,
         registry_poll_ms=float(opts["registry_poll_ms"]),
+        pin_version=int(opts["pin_version"]) or None,
         max_batch_size=int(opts["max_batch_size"]),
         max_delay_ms=float(opts["max_delay_ms"]),
         max_queue_rows=int(opts["max_queue_rows"]),

@@ -142,3 +142,49 @@ def test_ooc_section_error_never_gates(tmp_path):
            "out_of_core": {"error": "RuntimeError: boom"}}
     assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
     assert "gate_ooc" not in out
+
+
+def _factory(rows=8_000, rounds=10, e2e_s=1.0):
+    return {"rows": rows, "num_boost_round": rounds,
+            "append_to_promoted_s": e2e_s}
+
+
+def test_factory_gate_fires_on_slow_cycle(tmp_path):
+    """The factory append->promoted latency gates independently of the
+    headline, at the wider 1.5x host-work threshold."""
+    _capture(tmp_path, "BENCH_r01.json", 0.10, factory=_factory(e2e_s=1.0))
+    out = {"metric": METRIC, "value": 0.10,
+           "factory": _factory(e2e_s=1.6)}  # 60% slower: over the band
+    rc = bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={})
+    assert rc == 1
+    assert out.get("regression_factory") is True
+    assert "regression" not in out
+    assert out["gate_factory"]["best_prior_append_to_promoted_s"] == 1.0
+    assert out["gate_factory"]["threshold_s"] == pytest.approx(1.5)
+
+
+def test_factory_gate_passes_within_band(tmp_path):
+    _capture(tmp_path, "BENCH_r01.json", 0.10, factory=_factory(e2e_s=1.0))
+    out = {"metric": METRIC, "value": 0.10, "factory": _factory(e2e_s=1.4)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+    assert "regression_factory" not in out
+    assert out["gate_factory"]["best_prior_append_to_promoted_s"] == 1.0
+
+
+def test_factory_gate_requires_same_grid(tmp_path):
+    # a prior at a different (rows, rounds) grid is a different cycle
+    _capture(tmp_path, "BENCH_r01.json", 0.10,
+             factory=_factory(rows=80_000, e2e_s=0.5))
+    out = {"metric": METRIC, "value": 0.10, "factory": _factory(e2e_s=9.9)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+    assert "gate_factory" not in out and "regression_factory" not in out
+
+
+def test_factory_section_error_never_gates(tmp_path):
+    _capture(tmp_path, "BENCH_r01.json", 0.10, factory=_factory(e2e_s=1.0))
+    out = {"metric": METRIC, "value": 0.10,
+           "factory": {"error": "RuntimeError: boom",
+                       "append_to_promoted_s": 9.9,
+                       "rows": 8_000, "num_boost_round": 10}}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+    assert "gate_factory" not in out

@@ -684,7 +684,7 @@ class TestNameRegistryLint:
     TRACER_PAT = re.compile(
         r'tracer\.(?:span|counter|gauge|event)\(\s*[\'"]([A-Za-z0-9_.]+)[\'"]')
     METRIC_PAT = re.compile(
-        r'(?:registry|reg)\.(?:counter|gauge|histogram)\(\s*\n?\s*'
+        r'(?:registry|reg)\.(?:labeled_)?(?:counter|gauge|histogram)\(\s*\n?\s*'
         r'[\'"]([A-Za-z0-9_:]+)[\'"]')
 
     def _source_names(self):
