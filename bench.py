@@ -166,6 +166,67 @@ def apply_regression_gate(out: dict, bench_dir: str = None, env=None) -> int:
                 if float(val_s) > thr_s:
                     out["regression_swap"] = True
                     rc = 1
+    # quantized leg (independent): three device-independent contracts
+    # gate outright, no prior needed — the quantized same-shape swap must
+    # compile NOTHING, the measured drift must sit inside its documented
+    # bound, and the quantized payload must be at least 2x smaller.  The
+    # batch-2048 speedup gates against the best prior capture's speedup
+    # (not an absolute floor, so a faster exact baseline can't fail it
+    # spuriously) at the same 1.10 slack as the s/iter legs.
+    qz = out.get("quantized") or {}
+    if qz and not qz.get("error"):
+        qsw = qz.get("swap") or {}
+        if isinstance(qsw.get("swap_new_compiles"), int) and \
+                qsw["swap_new_compiles"] > 0:
+            out["regression_quant_swap_compiles"] = True
+            rc = 1
+        dr = qz.get("drift") or {}
+        if dr and not dr.get("within_bound"):
+            out["regression_quant_drift"] = True
+            rc = 1
+        ab = qz.get("artifact_bytes") or {}
+        ratio = ab.get("payload_ratio")
+        if isinstance(ratio, (int, float)) and ratio < 2.0:
+            out["regression_quant_bytes"] = True
+            rc = 1
+        val_q = (qz.get("batch2048") or {}).get("speedup")
+        if isinstance(val_q, (int, float)) and val_q > 0:
+            best_q, src_q = None, None
+            for path in sorted(glob.glob(os.path.join(bench_dir,
+                                                      "BENCH_r*.json"))):
+                try:
+                    with open(path) as f:
+                        doc = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                parsed = doc.get("parsed") if isinstance(doc, dict) else None
+                if not isinstance(parsed, dict):
+                    parsed = doc if isinstance(doc, dict) else {}
+                if parsed.get("backend_fallback"):
+                    continue
+                pq = ((parsed.get("quantized") or {}).get("batch2048")
+                      or {}).get("speedup")
+                if isinstance(pq, (int, float)) and pq > 0 and (
+                        best_q is None or pq > best_q):
+                    best_q, src_q = float(pq), os.path.basename(path)
+            if best_q is not None:
+                thr_q = best_q / 1.10
+                out["gate_quantized"] = {
+                    "best_prior_speedup_batch2048": round(best_q, 3),
+                    "best_prior_source": src_q,
+                    "threshold_speedup": round(thr_q, 3),
+                }
+                if float(val_q) < thr_q:
+                    out["regression_quantized"] = True
+                    rc = 1
+    # multi-model leg (independent): the admission-refusal probe is a
+    # device-independent correctness contract — a budget overrun that is
+    # NOT refused loudly is a regression outright
+    mm = out.get("multimodel") or {}
+    if mm and not mm.get("error") and \
+            mm.get("admission_refusal_ok") is False:
+        out["regression_multimodel_admission"] = True
+        rc = 1
     # factory leg (independent): the append->promoted e2e latency gates
     # against priors at the same (rows, num_boost_round) grid.  Wider
     # 1.5x threshold: the cycle is host work (staging, eval, registry
@@ -318,6 +379,182 @@ def _bench_swap(packed, warmup_rows, n_swaps=5):
         }
     except Exception as e:  # pragma: no cover — swap must not kill bench
         section["error"] = f"{type(e).__name__}: {e}"
+    return section
+
+
+def _bench_quantized(booster, X, batch_sizes=(1, 128, 2048), reps=20):
+    """Quantized-serving A/B (docs/SERVING.md): exact vs int16
+    rank-quantized predictor at fixed batch sizes, the artifact size of
+    both flavors, the measured leaf-narrowing drift against its
+    documented bound, and the quantized same-shape hot-swap compile
+    count (must be 0, same contract as the exact swap leg)."""
+    import io
+
+    from lightgbm_tpu.ops.predict import TreeArrays
+    from lightgbm_tpu.ops.qpredict import drift_bound
+    from lightgbm_tpu.serve.artifact import PackedPredictor, PredictorArtifact
+    from lightgbm_tpu.serve.fleet import SwappablePredictor
+
+    section = {}
+    try:
+        exact_art = PredictorArtifact.from_booster(booster)
+        quant_art = exact_art.quantize()
+
+        def _file_bytes(a):
+            buf = io.BytesIO()
+            a.save_to_bytes(buf)
+            return len(buf.getvalue())
+
+        def _payload_bytes(a):
+            return int(sum(arr.nbytes for arr in a._payload().values()))
+
+        exact = PackedPredictor(exact_art, quantized=False)
+        quant = PackedPredictor(quant_art)
+        section["artifact_bytes"] = {
+            "exact_file": _file_bytes(exact_art),
+            "quantized_file": _file_bytes(quant_art),
+            "exact_payload": _payload_bytes(exact_art),
+            "quantized_payload": _payload_bytes(quant_art),
+            "exact_device": exact.device_bytes,
+            "quantized_device": quant.device_bytes,
+            "payload_ratio": round(_payload_bytes(exact_art)
+                                   / max(_payload_bytes(quant_art), 1), 2),
+            "device_ratio": round(exact.device_bytes
+                                  / max(quant.device_bytes, 1), 2),
+        }
+        max_bucket = max(batch_sizes)
+        exact.warmup(max_bucket)
+        quant.warmup(max_bucket)
+        sample = np.ascontiguousarray(X[:min(2048, X.shape[0])], np.float64)
+        diff = float(np.abs(quant.predict(sample, raw_score=True)
+                            - exact.predict(sample, raw_score=True)).max())
+        bound = drift_bound(exact_art.arrays.leaf_value)
+        section["drift"] = {"max_abs": diff, "bound": bound,
+                            "within_bound": bool(diff <= bound)}
+        for bs in batch_sizes:
+            bs = min(bs, X.shape[0])
+            rows = np.ascontiguousarray(X[:bs], np.float64)
+            sub = {}
+            for name, p in (("exact", exact), ("quantized", quant)):
+                lat = []
+                for _ in range(reps):
+                    t0 = time.time()
+                    p.predict(rows)
+                    lat.append(time.time() - t0)
+                lat.sort()
+                p50 = lat[len(lat) // 2]
+                sub[name] = {
+                    "p50_ms": round(1e3 * p50, 3),
+                    "p99_ms": round(
+                        1e3 * lat[min(len(lat) - 1, int(0.99 * len(lat)))],
+                        3),
+                    "rows_per_s": round(bs / p50, 1),
+                }
+            sub["speedup"] = round(sub["quantized"]["rows_per_s"]
+                                   / max(sub["exact"]["rows_per_s"], 1e-9), 3)
+            section[f"batch{bs}"] = sub
+        # quantized same-shape hot swap: zero new XLA compiles
+        swapper = SwappablePredictor(quant, version=1)
+        lat_ms, new_compiles = [], 0
+        for i in range(3):
+            fields = {f: np.asarray(getattr(exact_art.arrays, f))
+                      for f in TreeArrays.FIELDS}
+            fields["leaf_value"] = fields["leaf_value"] * (1.0 + 1e-4 * (i + 1))
+            retrain = PredictorArtifact(
+                TreeArrays(**fields), exact_art.meta).quantize()
+            stats = swapper.swap_to(retrain, version=i + 2,
+                                    warmup_max_rows=max_bucket)
+            lat_ms.append(stats["swap_ms"])
+            new_compiles += stats["new_compiles"]
+        lat_ms.sort()
+        section["swap"] = {
+            "swaps": 3,
+            "swap_latency_p50_ms": round(lat_ms[len(lat_ms) // 2], 3),
+            "swap_new_compiles": int(new_compiles),
+        }
+    except Exception as e:  # pragma: no cover — must not kill bench
+        section["error"] = f"{type(e).__name__}: {e}"
+    return section
+
+
+def _bench_multimodel(booster, X, n_models=4, reps=10, batch=128):
+    """Multi-model bin-packing (docs/SERVING.md): N models behind named
+    routes on ONE server process, per-model rows/s through the full
+    HTTP + microbatch path, the shared device-bytes admission ledger,
+    and a budget-refusal probe (the loud-failure contract)."""
+    import json as _json
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    from lightgbm_tpu.ops.predict import TreeArrays
+    from lightgbm_tpu.serve.artifact import PredictorArtifact
+    from lightgbm_tpu.serve.registry import ModelRegistry
+    from lightgbm_tpu.serve.server import make_server
+
+    section = {}
+    tmp = tempfile.mkdtemp(prefix="ltpu-bench-mm-")
+    srv = None
+    try:
+        art = PredictorArtifact.from_booster(booster)
+        reg = ModelRegistry(os.path.join(tmp, "reg"))
+        reg.publish(art)  # v1 = the default route
+        routes = []
+        for i in range(n_models - 1):
+            fields = {f: np.asarray(getattr(art.arrays, f))
+                      for f in TreeArrays.FIELDS}
+            fields["leaf_value"] = fields["leaf_value"] * (1.0 + 0.1 * (i + 1))
+            retrain = PredictorArtifact(TreeArrays(**fields), art.meta)
+            if i % 2 == 0:  # alternate flavors to prove they co-pack
+                retrain = retrain.quantize()
+            v = reg.publish(retrain, activate=False)
+            name = f"m{i + 1}"
+            reg.set_route(name, v)
+            routes.append(name)
+        srv = make_server(registry_dir=reg.dir, port=0,
+                          warmup_max_rows=batch, max_delay_ms=1.0,
+                          registry_poll_ms=10_000.0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        port = srv.server_address[1]
+        rows = np.ascontiguousarray(X[:batch], np.float64)
+        body = "\n".join(
+            _json.dumps([float(v) for v in r]) for r in rows).encode()
+
+        def _rows_per_s(path):
+            lat = []
+            for _ in range(reps):
+                t0 = time.time()
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", data=body,
+                    timeout=60).read()
+                lat.append(time.time() - t0)
+            lat.sort()
+            return round(len(rows) / lat[len(lat) // 2], 1)
+
+        per_model = {"default": _rows_per_s("/predict")}
+        for name in routes:
+            per_model[name] = _rows_per_s(f"/predict/{name}")
+        section = {
+            "n_models": n_models,
+            "per_model_rows_per_s": per_model,
+            "device_bytes_used": srv.device_bytes_used(),
+        }
+        # admission-refusal probe: a budget below the current usage must
+        # refuse the next route loudly and leave the admitted ones alone
+        srv.route_budget_bytes = srv.device_bytes_used() + 1
+        reg.set_route("overbudget", 1)
+        srv.sync_routes()
+        refused = "overbudget" in srv.admission_refused
+        still_serving = all(r in srv.routes for r in routes)
+        section["admission_refusal_ok"] = bool(refused and still_serving)
+    except Exception as e:  # pragma: no cover — must not kill bench
+        section["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+        shutil.rmtree(tmp, ignore_errors=True)
     return section
 
 
@@ -1098,6 +1335,20 @@ def main():
     # new compiles (the serving acceptance contract).
     if os.environ.get("BENCH_SERVING", "0" if backend_fallback else "1") != "0":
         out["serving"] = _bench_serving(booster, X)
+
+    # quantized-serving section (docs/SERVING.md): exact vs int16
+    # rank-quantized predictor rows/s, both artifact flavors' bytes, the
+    # measured leaf drift vs its bound, and the quantized same-shape
+    # swap compile count — its own regression-gate leg
+    if os.environ.get("BENCH_QUANT", "0" if backend_fallback else "1") != "0":
+        out["quantized"] = _bench_quantized(booster, X)
+
+    # multi-model section (docs/SERVING.md): N=4 models bin-packed on
+    # one chip behind named routes, per-model rows/s through the full
+    # HTTP path, and the admission-refusal probe
+    if os.environ.get("BENCH_MULTIMODEL",
+                      "0" if backend_fallback else "1") != "0":
+        out["multimodel"] = _bench_multimodel(booster, X)
 
     # streaming-ingest section (docs/DATA.md): rows/s + the peak-RSS
     # bound proving the raw float matrix never materialized.  At

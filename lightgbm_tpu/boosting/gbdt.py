@@ -834,7 +834,26 @@ class GBDT:
 
         if os.environ.get("LIGHTGBM_TPU_PREDICT_BUCKETS", "1") == "0":
             return self._predict_raw_scores_unbucketed(data, models, k)
+        from ..ops.qpredict import quant_predict_enabled
+
         key = (len(models), k)
+        if quant_predict_enabled():
+            # LIGHTGBM_TPU_QUANT_PREDICT=1: int16 rank-quantized
+            # traversal (ops/qpredict.py) — route decisions are exact,
+            # leaf values narrow to f16 (drift_bound documents the
+            # output bound); unset/0 keeps the bit-exact default
+            cached = getattr(self, "_quantized_predictor", None)
+            if cached is None or cached[0] != key:
+                from ..ops.qpredict import quantize_tree_arrays
+                from ..serve.artifact import stacked_tree_arrays
+                from ..serve.compilecache import BucketedQuantizedPredictor
+
+                q = quantize_tree_arrays(
+                    stacked_tree_arrays(models),
+                    num_features=int(self.max_feature_idx) + 1)
+                cached = (key, BucketedQuantizedPredictor.from_qtree_arrays(q, k))
+                self._quantized_predictor = cached
+            return cached[1].predict_raw_scores(np.asarray(data, np.float64))
         cached = getattr(self, "_bucketed_predictor", None)
         if cached is None or cached[0] != key:
             from ..serve.compilecache import BucketedRawPredictor

@@ -1,0 +1,411 @@
+"""Quantized batched tree traversal — the serving-only narrow-int path.
+
+``ops/predict.predict_raw`` reproduces the reference's float64 decisions
+with a triple-float (3 x f32 plane) lexicographic compare at every node:
+three (N, F) data-plane gathers and nine comparisons per step.  But a
+trained model only ever compares a feature against the *finite set* of
+thresholds its own nodes hold, so the whole decision structure survives
+rank quantization: map every value to its integer rank among the
+feature's thresholds and one int16 compare per node decides routing
+EXACTLY as the f64 reference does.
+
+Encoding (per feature, host-side, float64 throughout):
+
+  ``table`` = sorted distinct thresholds the model's nodes use on this
+  feature (categorical features store ``trunc(threshold)``, matching the
+  reference's integer-cast identity compare).  A value ``v`` encodes as
+
+      code(v) = 2 * searchsorted(table, v, side="left") + (v in table)
+
+  so a node threshold ``t = table[i]`` gets the odd code ``2i + 1`` and
+
+      numeric:      code(v) <= 2i + 1  <=>  v <= t      (exactly)
+      categorical:  code(v) == 2i + 1  <=>  v == t      (exactly)
+
+  Zero/missing rows (the DefaultValueForZero remap, plus NaN) get the
+  sentinel ``ZERO_CODE``; each node carries ``default_q``, its
+  ``default_value`` pre-encoded in f64 on the host, so the remap is a
+  single integer select.  There is no "bin boundary" caveat: route
+  decisions agree with the exact path for every input.
+
+The node SoA is narrowed to int16/int8 (codes are bounded by twice the
+per-feature threshold count, far under 2**15 for any ``max_bin``-built
+model) and **level-packed**: nodes are reordered breadth-first so each
+depth level is a contiguous index range and the maximum depth is a
+static ``levels`` bound, letting traversal run as a ``fori_loop`` with
+no per-step cross-batch ``any()`` reduction (the ``while_loop`` exit
+test the exact path pays every level).  Leaf values are stored f16 (or
+bf16) and accumulated in f32 — the ONLY source of drift vs the exact
+path, bounded by ``drift_bound``.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import List, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..io.binning import MISSING_VALUE_RANGE
+from ..utils.log import Log
+
+# data code for zero/missing rows (never a valid rank code, which are >= 0)
+ZERO_CODE = np.int16(-1)
+
+# widest representable rank code / node index / feature index
+_I16_MAX = 32767
+
+LEAF_DTYPES = ("float16", "bfloat16")
+
+
+def quant_predict_enabled(default: bool = False) -> bool:
+    """The ``LIGHTGBM_TPU_QUANT_PREDICT`` pin, read live per call:
+    ``0`` forces the exact path everywhere (the documented opt-out),
+    ``1`` opts ``Booster.predict`` / serving into the quantized path,
+    unset defers to the caller's ``default``."""
+    v = os.environ.get("LIGHTGBM_TPU_QUANT_PREDICT")
+    if v is None:
+        return bool(default)
+    return v.strip().lower() not in ("0", "false", "off", "")
+
+
+def _leaf_np_dtype(leaf_dtype: str):
+    if leaf_dtype == "float16":
+        return np.float16
+    if leaf_dtype == "bfloat16":
+        import ml_dtypes  # ships with jax
+
+        return ml_dtypes.bfloat16
+    Log.fatal("Unsupported quantized leaf dtype %r (supported: %s)",
+              leaf_dtype, ", ".join(LEAF_DTYPES))
+
+
+class QTreeArrays:
+    """Stacked quantized SoA for T trees: narrow node planes plus the
+    host-side per-feature threshold tables that encode request data.
+
+    ``levels`` is the static traversal bound (1 + max node depth); the
+    compile cache pads it up the same power-of-two ladder as M/L so
+    same-shape-class models share every XLA program.
+    """
+
+    NODE_FIELDS = (
+        "split_feature",  # (T, M) int16 — original feature index
+        "threshold_q",  # (T, M) int16 — odd rank code of the threshold
+        "default_q",  # (T, M) int16 — rank code of default_value
+        "flags",  # (T, M) int8 — bit0: categorical
+        "left_child",  # (T, M) int16 (>=0 node, <0 -> leaf ~idx)
+        "right_child",  # (T, M) int16
+        "leaf_value",  # (T, L) f16/bf16 (post-shrinkage)
+    )
+    TABLE_FIELDS = (
+        "qbin_edges",  # (E,) f64 — per-feature tables, flattened
+        "qbin_offsets",  # (F+1,) int32 — table j is edges[off[j]:off[j+1]]
+        "feature_flags",  # (F,) int8 — bit0: categorical compare (trunc)
+    )
+    FIELDS = NODE_FIELDS + TABLE_FIELDS
+
+    def __init__(self, levels: int, **kw):
+        self.levels = int(levels)
+        for f in self.FIELDS:
+            setattr(self, f, kw[f])
+
+    @property
+    def leaf_dtype(self) -> str:
+        return str(jnp.dtype(self.leaf_value.dtype).name)
+
+    def validate(self) -> "QTreeArrays":
+        t_m = None
+        for f in self.NODE_FIELDS:
+            a = getattr(self, f)
+            shape = tuple(getattr(a, "shape", ()))
+            if len(shape) != 2:
+                raise ValueError(
+                    f"QTreeArrays.{f} must be 2-D, got shape {shape}")
+            if f == "leaf_value":
+                if t_m is not None and shape[0] != t_m[0]:
+                    raise ValueError(
+                        f"QTreeArrays.leaf_value has {shape[0]} trees but "
+                        f"the node arrays have {t_m[0]}")
+                if self.leaf_dtype not in LEAF_DTYPES:
+                    raise ValueError(
+                        f"QTreeArrays.leaf_value dtype {self.leaf_dtype} "
+                        f"is not one of {LEAF_DTYPES}")
+            elif t_m is None:
+                t_m = shape
+            elif shape != t_m:
+                raise ValueError(
+                    f"QTreeArrays.{f} has shape {shape}, expected {t_m}")
+        off = np.asarray(self.qbin_offsets)
+        edges = np.asarray(self.qbin_edges)
+        if off.ndim != 1 or off.size < 1 or off[0] != 0 \
+                or off[-1] != edges.size or np.any(np.diff(off) < 0):
+            raise ValueError(
+                "QTreeArrays.qbin_offsets must be a monotone prefix-sum "
+                "ending at len(qbin_edges)")
+        if np.asarray(self.feature_flags).shape != (off.size - 1,):
+            raise ValueError(
+                "QTreeArrays.feature_flags must have one entry per feature")
+        if self.levels < 1:
+            raise ValueError("QTreeArrays.levels must be >= 1")
+        return self
+
+    @property
+    def num_features(self) -> int:
+        return int(np.asarray(self.qbin_offsets).size - 1)
+
+
+def _encode(table: np.ndarray, v: np.ndarray) -> np.ndarray:
+    """Rank codes (int64) of ``v`` against one sorted threshold table."""
+    v = np.asarray(v, np.float64)
+    i = np.searchsorted(table, v, side="left")
+    exact = (i < table.size) & (table[np.minimum(i, table.size - 1)] == v) \
+        if table.size else np.zeros(v.shape, bool)
+    return 2 * i + exact
+
+
+def _bfs_order(left: np.ndarray, right: np.ndarray) -> Tuple[np.ndarray, int]:
+    """Breadth-first node order for one tree (root = node 0).
+
+    Returns the visit order (depth-major, unreachable padded slots
+    appended last so array shapes are preserved) and 1 + max depth."""
+    m = left.shape[0]
+    depth = np.full(m, -1, np.int64)
+    order: List[int] = []
+    frontier = [0]
+    depth[0] = 0
+    d = 0
+    while frontier:
+        order.extend(frontier)
+        nxt = []
+        for j in frontier:
+            for c in (left[j], right[j]):
+                if c >= 0 and depth[c] < 0:
+                    depth[c] = d + 1
+                    nxt.append(int(c))
+        frontier = nxt
+        d += 1
+    levels = int(depth.max()) + 1
+    order.extend(j for j in range(m) if depth[j] < 0)
+    return np.asarray(order, np.int64), levels
+
+
+def quantize_tree_arrays(arrays, leaf_dtype: str = "float16",
+                         num_features: int = 0) -> QTreeArrays:
+    """Quantize an exact host-side ``TreeArrays`` into a ``QTreeArrays``.
+
+    The f64 thresholds/default values are recovered exactly from the
+    triple-float planes (hi + lo + lo2 sums back to the original double
+    with no rounding — the planes are non-overlapping by construction),
+    so quantizing a loaded artifact is as lossless as quantizing the
+    Booster itself.
+    """
+    feat = np.asarray(arrays.split_feature_real, np.int64)
+    thr = (np.asarray(arrays.threshold_real, np.float64)
+           + np.asarray(arrays.threshold_real_lo, np.float64)
+           + np.asarray(arrays.threshold_real_lo2, np.float64))
+    dv = (np.asarray(arrays.default_value_real, np.float64)
+          + np.asarray(arrays.default_value_real_lo, np.float64)
+          + np.asarray(arrays.default_value_real_lo2, np.float64))
+    is_cat = np.asarray(arrays.is_categorical, bool)
+    left = np.asarray(arrays.left_child, np.int64)
+    right = np.asarray(arrays.right_child, np.int64)
+    leaf = np.asarray(arrays.leaf_value, np.float32)
+
+    t, m = feat.shape
+    if m > _I16_MAX:
+        Log.fatal(
+            "Quantized serving supports at most %d nodes per tree, this "
+            "model has %d — serve the exact artifact instead", _I16_MAX, m)
+    num_features = max(int(feat.max()) + 1 if t else 1, int(num_features))
+    if num_features > _I16_MAX:
+        Log.fatal(
+            "Quantized serving supports at most %d features, this model "
+            "uses feature index %d — serve the exact artifact instead",
+            _I16_MAX, num_features - 1)
+
+    # reachable internal nodes + breadth-first level packing, per tree
+    orders = np.empty((t, m), np.int64)
+    reach = np.zeros((t, m), bool)
+    levels = 1
+    for i in range(t):
+        order, lv = _bfs_order(left[i], right[i])
+        orders[i] = order
+        levels = max(levels, lv)
+        # _bfs_order appends unreachable padding slots after the visited
+        # prefix; the visited count = nodes with a BFS depth
+        seen = np.zeros(m, bool)
+        seen[0] = True
+        stack = [0]
+        while stack:
+            j = stack.pop()
+            for c in (left[i, j], right[i, j]):
+                if c >= 0 and not seen[c]:
+                    seen[c] = True
+                    stack.append(int(c))
+        reach[i] = seen
+
+    # per-feature threshold tables from reachable nodes only, with the
+    # categorical trunc transform folded in (identity compare on ints)
+    feature_flags = np.zeros(num_features, np.int8)
+    for j in np.unique(feat[reach & is_cat]):
+        feature_flags[j] = 1
+    tables: List[np.ndarray] = []
+    offsets = np.zeros(num_features + 1, np.int32)
+    for j in range(num_features):
+        mask = reach & (feat == j)
+        tj = thr[mask]
+        if feature_flags[j]:
+            tj = np.trunc(tj)
+        table = np.unique(tj)
+        if 2 * table.size + 1 > _I16_MAX:
+            Log.fatal(
+                "Quantized serving supports at most %d distinct "
+                "thresholds per feature, feature %d has %d — serve the "
+                "exact artifact instead", (_I16_MAX - 1) // 2, j, table.size)
+        tables.append(table)
+        offsets[j + 1] = offsets[j] + table.size
+    edges = np.concatenate(tables) if tables else np.zeros(0, np.float64)
+
+    # encode every node's threshold/default vectorized per feature, in
+    # the ORIGINAL node order (the BFS gather below reorders them)
+    thr_codes = np.zeros((t, m), np.int64)
+    def_codes = np.zeros((t, m), np.int64)
+    for j in range(num_features):
+        mask = feat == j
+        if not mask.any():
+            continue
+        tv, dvv = thr[mask], dv[mask]
+        if feature_flags[j]:
+            tv, dvv = np.trunc(tv), np.trunc(dvv)
+        thr_codes[mask] = _encode(tables[j], tv)
+        def_codes[mask] = _encode(tables[j], dvv)
+
+    # gather per-node fields into BFS order; remap child node indices
+    q_feat = np.zeros((t, m), np.int16)
+    q_thr = np.zeros((t, m), np.int16)
+    q_def = np.zeros((t, m), np.int16)
+    q_flags = np.zeros((t, m), np.int8)
+    q_left = np.zeros((t, m), np.int16)
+    q_right = np.zeros((t, m), np.int16)
+    for i in range(t):
+        order = orders[i]
+        newpos = np.empty(m, np.int64)
+        newpos[order] = np.arange(m)
+        q_feat[i] = feat[i, order].astype(np.int16)
+        q_thr[i] = thr_codes[i, order].astype(np.int16)
+        q_def[i] = def_codes[i, order].astype(np.int16)
+        q_flags[i] = is_cat[i, order].astype(np.int8)
+        lo_ = left[i, order]
+        ro_ = right[i, order]
+        q_left[i] = np.where(lo_ >= 0, newpos[np.maximum(lo_, 0)],
+                             lo_).astype(np.int16)
+        q_right[i] = np.where(ro_ >= 0, newpos[np.maximum(ro_, 0)],
+                              ro_).astype(np.int16)
+
+    return QTreeArrays(
+        levels=levels,
+        split_feature=q_feat,
+        threshold_q=q_thr,
+        default_q=q_def,
+        flags=q_flags,
+        left_child=q_left,
+        right_child=q_right,
+        leaf_value=leaf.astype(_leaf_np_dtype(leaf_dtype)),
+        qbin_edges=edges,
+        qbin_offsets=offsets,
+        feature_flags=feature_flags,
+    ).validate()
+
+
+def quantize_data(data: np.ndarray, qbin_edges: np.ndarray,
+                  qbin_offsets: np.ndarray,
+                  feature_flags: np.ndarray) -> np.ndarray:
+    """(N, F) int16 rank codes for raw (N, >=F) float64 features.
+
+    The zero/missing remap happens HERE, in plain f64 (``|v|`` inside
+    (-MISSING_VALUE_RANGE, MISSING_VALUE_RANGE] or NaN -> ``ZERO_CODE``)
+    — host binning sees the original doubles, so the test needs no
+    triple-float reconstruction like the exact device path does."""
+    edges = np.asarray(qbin_edges, np.float64)
+    offsets = np.asarray(qbin_offsets, np.int64)
+    flags = np.asarray(feature_flags)
+    nf = offsets.size - 1
+    data = np.asarray(data, np.float64)
+    if data.ndim == 1:
+        data = data.reshape(1, -1)
+    out = np.empty((data.shape[0], nf), np.int16)
+    mr = float(MISSING_VALUE_RANGE)
+    for j in range(nf):
+        v = data[:, j]
+        is_zero = ((v > -mr) & (v <= mr)) | np.isnan(v)
+        vv = np.where(is_zero, 0.0, v)
+        if flags[j]:
+            vv = np.trunc(vv)
+        code = _encode(edges[offsets[j]:offsets[j + 1]], vv)
+        out[:, j] = np.where(is_zero, ZERO_CODE, code).astype(np.int16)
+    return out
+
+
+def drift_bound(leaf_value, leaf_dtype: str = "float16") -> float:
+    """Documented bound on |quantized - exact| raw scores for one class
+    of stacked trees: route decisions are exact, so the only drift is
+    the leaf-value narrowing (half an ulp of each tree's largest |leaf|
+    in the target dtype) plus f32 re-accumulation slack."""
+    leaf = np.abs(np.asarray(leaf_value, np.float64))
+    if leaf.size == 0:
+        return 0.0
+    maxabs = leaf.max(axis=-1)
+    dt = _leaf_np_dtype(leaf_dtype)
+    half_ulp = np.float64(np.spacing(maxabs.astype(dt))) / 2.0
+    # f32 pairwise/sequential accumulation over T terms
+    accum = leaf.max() * leaf.shape[0] * float(np.finfo(np.float32).eps)
+    return float(np.sum(half_ulp) + accum)
+
+
+def _traverse_one_tree_q(qbins, feat, thr_q, def_q, flags, left, right,
+                         levels):
+    """(N,) leaf indices for one level-packed quantized tree."""
+    n = qbins.shape[0]
+    rows = jnp.arange(n)
+
+    def step(_, node):
+        j = jnp.maximum(node, 0)
+        q = qbins[rows, feat[j].astype(jnp.int32)]
+        fq = jnp.where(q == ZERO_CODE, def_q[j], q)
+        goes_left = jnp.where(
+            flags[j] != 0, fq == thr_q[j], fq <= thr_q[j])
+        nxt = jnp.where(goes_left, left[j], right[j]).astype(jnp.int32)
+        return jnp.where(node >= 0, nxt, node)
+
+    node = jnp.zeros((n,), jnp.int32)
+    node = jax.lax.fori_loop(0, levels, step, node)
+    return ~node
+
+
+@partial(jax.jit, static_argnames=("levels",))
+def qpredict_raw(qbins, split_feature, threshold_q, default_q, flags,
+                 left_child, right_child, leaf_value, levels):
+    """(N,) f32 raw scores over (N, F) int16 rank codes (one class)."""
+    leaves = jax.vmap(
+        _traverse_one_tree_q,
+        in_axes=(None, 0, 0, 0, 0, 0, 0, None),
+    )(qbins, split_feature, threshold_q, default_q, flags,
+      left_child, right_child, levels)  # (T, N)
+    vals = jnp.take_along_axis(leaf_value, leaves, axis=1)
+    return jnp.sum(vals.astype(jnp.float32), axis=0)
+
+
+@partial(jax.jit, static_argnames=("levels",))
+def qpredict_leaf(qbins, split_feature, threshold_q, default_q, flags,
+                  left_child, right_child, levels):
+    """(T, N) leaf indices (PredictLeafIndex mode, quantized)."""
+    return jax.vmap(
+        _traverse_one_tree_q,
+        in_axes=(None, 0, 0, 0, 0, 0, 0, None),
+    )(qbins, split_feature, threshold_q, default_q, flags,
+      left_child, right_child, levels)

@@ -30,7 +30,8 @@ See docs/SERVING.md for the artifact format and operational knobs.
 
 from .artifact import PackedPredictor, PredictorArtifact
 from .batcher import MicroBatcher, RequestTimeout, ServerOverloaded
-from .compilecache import (BucketedRawPredictor, bucket_for, bucket_ladder,
+from .compilecache import (BucketedQuantizedPredictor, BucketedRawPredictor,
+                           bucket_for, bucket_ladder, pad_qtree_arrays,
                            pad_tree_arrays, tree_shape_bucket)
 from .fleet import FleetProxy, SwappablePredictor
 from .registry import ModelRegistry
@@ -39,10 +40,12 @@ __all__ = [
     "PredictorArtifact",
     "PackedPredictor",
     "BucketedRawPredictor",
+    "BucketedQuantizedPredictor",
     "bucket_for",
     "bucket_ladder",
     "tree_shape_bucket",
     "pad_tree_arrays",
+    "pad_qtree_arrays",
     "MicroBatcher",
     "ServerOverloaded",
     "RequestTimeout",

@@ -28,7 +28,9 @@ import numpy as np
 from ..ops.predict import TreeArrays
 from ..utils.log import Log
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 1  # exact flavor — byte-stable since PR 9
+QUANT_FORMAT_VERSION = 2  # quantized flavor (meta carries "flavor")
+SUPPORTED_VERSIONS = (FORMAT_VERSION, QUANT_FORMAT_VERSION)
 META_KEYS = (
     "format_version",
     "num_class",
@@ -40,6 +42,8 @@ META_KEYS = (
     "feature_names",
     "pandas_categorical",
 )
+# quantized (format_version 2) artifacts additionally require these
+QUANT_META_KEYS = ("flavor", "levels", "leaf_dtype")
 
 # stack_trees() dict key -> TreeArrays field name (the stacker predates
 # TreeArrays and names the real-feature plane "split_feature")
@@ -76,17 +80,25 @@ def stacked_tree_arrays(models: List) -> TreeArrays:
 
 
 class PredictorArtifact:
-    """Host-side packed model: a ``TreeArrays`` + metadata dict."""
+    """Host-side packed model: a ``TreeArrays`` (exact flavor) or a
+    ``QTreeArrays`` (quantized flavor) + metadata dict."""
 
-    def __init__(self, arrays: TreeArrays, meta: Dict):
+    def __init__(self, arrays, meta: Dict):
         self.arrays = arrays
         self.meta = dict(meta)
         self.validate()
 
     # -- construction --------------------------------------------------
     @classmethod
-    def from_booster(cls, booster, num_iteration: int = -1) -> "PredictorArtifact":
-        """Freeze a trained/loaded ``Booster``'s inference state."""
+    def from_booster(cls, booster, num_iteration: int = -1,
+                     quantized: bool = False,
+                     leaf_dtype: str = "float16") -> "PredictorArtifact":
+        """Freeze a trained/loaded ``Booster``'s inference state.
+
+        ``quantized=True`` packs the int16 rank-quantized flavor
+        (format_version 2, see ops/qpredict.py) instead of the exact
+        triple-float arrays; the exact flavor stays the default and the
+        bit-exact reference."""
         b = booster.boosting
         models = b._used_models(num_iteration)
         if not models:
@@ -106,22 +118,55 @@ class PredictorArtifact:
             "feature_names": list(b.feature_names or []),
             "pandas_categorical": getattr(booster, "pandas_categorical", []) or [],
         }
-        return cls(stacked_tree_arrays(models), meta)
+        art = cls(stacked_tree_arrays(models), meta)
+        return art.quantize(leaf_dtype) if quantized else art
+
+    @property
+    def flavor(self) -> str:
+        return str(self.meta.get("flavor", "exact"))
+
+    def quantize(self, leaf_dtype: str = "float16") -> "PredictorArtifact":
+        """The quantized flavor of this artifact (exact route parity;
+        see ops/qpredict.py).  Quantizing a quantized artifact returns
+        it unchanged."""
+        if self.flavor == "quantized":
+            return self
+        from ..ops.qpredict import quantize_tree_arrays
+
+        q = quantize_tree_arrays(self.arrays, leaf_dtype=leaf_dtype,
+                                 num_features=self.num_features)
+        meta = dict(self.meta)
+        meta["format_version"] = QUANT_FORMAT_VERSION
+        meta["flavor"] = "quantized"
+        meta["levels"] = int(q.levels)
+        meta["leaf_dtype"] = q.leaf_dtype
+        return PredictorArtifact(q, meta)
 
     # -- persistence ---------------------------------------------------
-    def save(self, path: str) -> str:
-        payload = {f: getattr(self.arrays, f) for f in TreeArrays.FIELDS}
+    def _payload(self) -> Dict[str, np.ndarray]:
+        if self.flavor == "quantized":
+            from ..ops.qpredict import QTreeArrays
+
+            payload = {f: np.asarray(getattr(self.arrays, f))
+                       for f in QTreeArrays.FIELDS}
+            # bfloat16 is not a native numpy dtype — persist raw bits;
+            # meta["leaf_dtype"] tells the loader how to view them back
+            if self.meta.get("leaf_dtype") == "bfloat16":
+                payload["leaf_value"] = payload["leaf_value"].view(np.uint16)
+        else:
+            payload = {f: getattr(self.arrays, f) for f in TreeArrays.FIELDS}
         payload["__meta__"] = np.asarray(json.dumps(self.meta))
-        np.savez_compressed(path, **payload)
+        return payload
+
+    def save(self, path: str) -> str:
+        np.savez_compressed(path, **self._payload())
         # np.savez appends .npz when missing — report the real path
         return path if path.endswith(".npz") else path + ".npz"
 
     def save_to_bytes(self, buf) -> None:
         """Serialize into a writable binary file-like (the registry
         publishes artifacts as bytes, never touching a temp path)."""
-        payload = {f: getattr(self.arrays, f) for f in TreeArrays.FIELDS}
-        payload["__meta__"] = np.asarray(json.dumps(self.meta))
-        np.savez_compressed(buf, **payload)
+        np.savez_compressed(buf, **self._payload())
 
     @classmethod
     def load(cls, path: str) -> "PredictorArtifact":
@@ -183,25 +228,37 @@ class PredictorArtifact:
             Log.fatal("%s carries an unparseable __meta__ header — the "
                       "artifact is corrupt; re-pack it", origin)
         version = int(meta.get("format_version", -1))
-        if version > FORMAT_VERSION:
+        if version > max(SUPPORTED_VERSIONS):
             Log.fatal(
                 "%s was written by a NEWER lightgbm_tpu (artifact "
                 "format_version %d, this build supports <= %d) — upgrade "
                 "this serving process, or re-pack the model with this "
-                "build", origin, version, FORMAT_VERSION)
-        if version != FORMAT_VERSION:
+                "build", origin, version, max(SUPPORTED_VERSIONS))
+        if version not in SUPPORTED_VERSIONS:
             Log.fatal(
                 "%s uses unsupported artifact format_version %s "
-                "(supported: %d) — re-pack the model with "
-                "PredictorArtifact.save", origin, version, FORMAT_VERSION)
-        missing = [f for f in TreeArrays.FIELDS if f not in z]
+                "(supported: %s) — re-pack the model with "
+                "PredictorArtifact.save", origin, version,
+                "/".join(str(v) for v in SUPPORTED_VERSIONS))
+        if version == QUANT_FORMAT_VERSION:
+            if meta.get("flavor") != "quantized":
+                Log.fatal(
+                    "%s claims artifact format_version %d but flavor %r "
+                    "(expected 'quantized') — the header is inconsistent; "
+                    "re-pack it", origin, version, meta.get("flavor"))
+            from ..ops.qpredict import QTreeArrays, _leaf_np_dtype
+
+            field_set = QTreeArrays.FIELDS
+        else:
+            field_set = TreeArrays.FIELDS
+        missing = [f for f in field_set if f not in z]
         if missing:
             Log.fatal(
                 "Artifact %s is missing tree arrays %s — the file is "
                 "truncated or from an incompatible writer; re-pack it",
                 origin, missing)
         try:
-            arrays = TreeArrays(**{f: z[f] for f in TreeArrays.FIELDS})
+            fields = {f: z[f] for f in field_set}
         except Exception as e:  # torn member: zipfile CRC error mid-read
             from ..utils.log import LightGBMError
 
@@ -211,12 +268,22 @@ class PredictorArtifact:
                 "Artifact %s fails while reading its tree arrays (%s: %s) "
                 "— the file is corrupt; re-pack it", origin,
                 type(e).__name__, e)
+        if version == QUANT_FORMAT_VERSION:
+            if meta.get("leaf_dtype") == "bfloat16":
+                fields["leaf_value"] = np.asarray(
+                    fields["leaf_value"]).view(_leaf_np_dtype("bfloat16"))
+            arrays = QTreeArrays(levels=int(meta.get("levels", 0)), **fields)
+        else:
+            arrays = TreeArrays(**fields)
         return cls(arrays, meta)
 
     # -- checks --------------------------------------------------------
     def validate(self) -> "PredictorArtifact":
         self.arrays.validate()
-        for key in META_KEYS:
+        required = META_KEYS
+        if self.flavor == "quantized":
+            required = META_KEYS + QUANT_META_KEYS
+        for key in required:
             if key not in self.meta:
                 Log.fatal("Artifact metadata is missing %r", key)
         t = self.arrays.split_feature.shape[0]
@@ -246,6 +313,34 @@ class PredictorArtifact:
     def num_features(self) -> int:
         return int(self.meta["num_features"])
 
+    def device_bytes_estimate(self) -> int:
+        """Bytes of tree state this artifact will hold resident on
+        device once served (after tree-shape padding) — computed from
+        shapes alone, so admission control can refuse a model BEFORE
+        anything is transferred to the device."""
+        import os
+
+        from .compilecache import _TREE_ARG_FIELDS, tree_shape_bucket
+
+        a = self.arrays
+        t, m = a.split_feature.shape
+        L = a.leaf_value.shape[1]
+        if os.environ.get("LIGHTGBM_TPU_TREE_SHAPE_BUCKETS", "1") == "0":
+            mb, lb = m, L
+        else:
+            mb, lb = tree_shape_bucket(m), tree_shape_bucket(L)
+        if self.flavor == "quantized":
+            from ..ops.qpredict import QTreeArrays
+
+            fields = QTreeArrays.NODE_FIELDS
+        else:
+            fields = _TREE_ARG_FIELDS
+        total = 0
+        for f in fields:
+            itemsize = np.dtype(getattr(a, f).dtype).itemsize
+            total += t * (lb if f == "leaf_value" else mb) * itemsize
+        return int(total)
+
     def make_objective(self):
         """Rebuild the objective from its model-string form (the same
         ``name key:value ...`` tokens Booster writes/loads)."""
@@ -256,17 +351,55 @@ class PredictorArtifact:
 
 class PackedPredictor:
     """Device-side serving predictor over a ``PredictorArtifact``:
-    bucketed raw traversal + the objective's output conversion, with the
-    same output shapes as ``Booster.predict``."""
+    bucketed traversal (exact or quantized, following the artifact's
+    flavor) + the objective's output conversion, with the same output
+    shapes as ``Booster.predict``.
 
-    def __init__(self, artifact: PredictorArtifact):
-        from .compilecache import BucketedRawPredictor
+    ``quantized=True`` asks for the int16 rank-quantized path even over
+    an exact artifact (it is quantized at construction); ``None``
+    follows the artifact flavor.  The ``LIGHTGBM_TPU_QUANT_PREDICT``
+    pin overrides both: ``0`` forces exact (a quantized-flavor artifact
+    has no exact planes left, so it keeps serving quantized with a loud
+    warning), ``1`` forces quantized."""
 
+    def __init__(self, artifact: PredictorArtifact,
+                 quantized: Optional[bool] = None):
+        from ..ops.qpredict import quant_predict_enabled
+        from .compilecache import (BucketedQuantizedPredictor,
+                                   BucketedRawPredictor)
+
+        want = (artifact.flavor == "quantized") if quantized is None \
+            else bool(quantized)
+        use_q = quant_predict_enabled(default=want)
+        if use_q and artifact.flavor == "exact":
+            artifact = artifact.quantize()
+        elif not use_q and artifact.flavor == "quantized":
+            Log.warning(
+                "Quantized predict is pinned off (LIGHTGBM_TPU_QUANT_"
+                "PREDICT=0 or quantized=False) but the artifact is "
+                "quantized-flavor, which carries no exact planes — "
+                "serving quantized; publish an exact (format_version 1) "
+                "artifact to serve the bit-exact path")
+            use_q = True
         self.artifact = artifact
+        self.quantized = bool(use_q)
         self.objective = artifact.make_objective()
-        self.raw = BucketedRawPredictor.from_tree_arrays(
-            artifact.arrays, artifact.num_tree_per_iteration
-        )
+        if self.quantized:
+            self.raw = BucketedQuantizedPredictor.from_qtree_arrays(
+                artifact.arrays, artifact.num_tree_per_iteration
+            )
+        else:
+            self.raw = BucketedRawPredictor.from_tree_arrays(
+                artifact.arrays, artifact.num_tree_per_iteration
+            )
+
+    @property
+    def device_bytes(self) -> int:
+        """Bytes of stacked tree state resident on device (after shape
+        padding) — the admission-control unit for multi-model packing."""
+        return int(sum(
+            a.nbytes for args in self.raw.class_arrays for a in args
+        ))
 
     @property
     def num_features(self) -> int:

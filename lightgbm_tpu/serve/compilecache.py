@@ -52,9 +52,24 @@ _TREE_ARG_FIELDS = (
     "leaf_value",
 )
 
+# the per-class tree-array arguments of qpredict_raw, in call order
+# (after the rank-code matrix)
+_Q_TREE_ARG_FIELDS = (
+    "split_feature",
+    "threshold_q",
+    "default_q",
+    "flags",
+    "left_child",
+    "right_child",
+    "leaf_value",
+)
+
 # one shared watch: every bucketed predict in the process (Booster.predict
 # and the serving subsystem) is accounted under "serve.predict_raw"
 _watched_predict_raw: Optional[JitWatch] = None
+
+# likewise for the quantized traversal, under "serve.qpredict"
+_watched_qpredict: Optional[JitWatch] = None
 
 
 def _watch() -> JitWatch:
@@ -62,6 +77,15 @@ def _watch() -> JitWatch:
     if _watched_predict_raw is None:
         _watched_predict_raw = JitWatch(predict_raw, "serve.predict_raw")
     return _watched_predict_raw
+
+
+def _qwatch() -> JitWatch:
+    global _watched_qpredict
+    if _watched_qpredict is None:
+        from ..ops.qpredict import qpredict_raw
+
+        _watched_qpredict = JitWatch(qpredict_raw, "serve.qpredict")
+    return _watched_qpredict
 
 
 def tree_shape_bucket(n: int) -> int:
@@ -105,6 +129,38 @@ def pad_tree_arrays(arrays: TreeArrays) -> TreeArrays:
         pad = (lb if f == "leaf_value" else mb) - a.shape[1]
         fields[f] = np.pad(a, ((0, 0), (0, pad))) if pad else a
     return TreeArrays(**fields).validate()
+
+
+def pad_qtree_arrays(arrays):
+    """Quantized counterpart of ``pad_tree_arrays``: pad the narrow node
+    planes to the same canonical (T, bucket(M))/(T, bucket(L)) shape
+    classes AND round the static ``levels`` traversal bound up the same
+    power-of-two ladder — ``levels`` is a static jit argument, so two
+    same-shape models with depths 11 and 13 would otherwise compile two
+    programs and break the zero-new-compile swap contract.  Extra
+    iterations past a tree's real depth are no-ops (every row already
+    sits on a leaf).  Same ``LIGHTGBM_TPU_TREE_SHAPE_BUCKETS=0``
+    opt-out."""
+    import os
+
+    from ..ops.qpredict import QTreeArrays
+
+    if os.environ.get("LIGHTGBM_TPU_TREE_SHAPE_BUCKETS", "1") == "0":
+        return arrays
+    m = arrays.split_feature.shape[1]
+    L = arrays.leaf_value.shape[1]
+    mb, lb = tree_shape_bucket(m), tree_shape_bucket(L)
+    levels = tree_shape_bucket(arrays.levels)
+    if mb == m and lb == L and levels == arrays.levels:
+        return arrays
+    fields = {}
+    for f in QTreeArrays.NODE_FIELDS:
+        a = np.asarray(getattr(arrays, f))
+        pad = (lb if f == "leaf_value" else mb) - a.shape[1]
+        fields[f] = np.pad(a, ((0, 0), (0, pad))) if pad else a
+    for f in QTreeArrays.TABLE_FIELDS:
+        fields[f] = getattr(arrays, f)
+    return QTreeArrays(levels=levels, **fields).validate()
 
 
 def bucket_for(n: int, min_bucket: int = DEFAULT_MIN_BUCKET,
@@ -260,6 +316,125 @@ class BucketedRawPredictor:
         """Precompile the bucket ladder up to ``max_rows`` rows.  Returns
         (and traces) the buckets touched and the compile count — after
         this, any request of size <= max(buckets) must hit the cache."""
+        if buckets is None:
+            buckets = bucket_ladder(max_rows, self.min_bucket, self._row_multiple)
+        c0 = compilewatch.total_compiles()
+        t0 = time.perf_counter()
+        with tracer.span("serve_warmup", buckets=len(buckets)):
+            for b in buckets:
+                self.predict_raw_scores(np.zeros((b, num_features)))
+        stats = {
+            "buckets": list(buckets),
+            "compiles": compilewatch.total_compiles() - c0,
+            "secs": round(time.perf_counter() - t0, 4),
+        }
+        tracer.event("serve_warmup_done", **stats)
+        return stats
+
+
+class BucketedQuantizedPredictor:
+    """Quantized counterpart of ``BucketedRawPredictor``: the same
+    bucket-padded batching and (K, N) float64 contract, but requests are
+    rank-encoded on the host (``ops/qpredict.quantize_data``) and
+    traversed with one int16 compare per node under the shared
+    "serve.qpredict" watch.  Same-shape-class models share every XLA
+    program (``pad_qtree_arrays``)."""
+
+    def __init__(self, class_arrays: List[tuple], qbin_edges, qbin_offsets,
+                 feature_flags, levels: int,
+                 min_bucket: int = DEFAULT_MIN_BUCKET, shard: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        self.num_class_arrays = len(class_arrays)
+        self.min_bucket = int(min_bucket)
+        self.levels = int(levels)
+        self._edges = np.asarray(qbin_edges, np.float64)
+        self._offsets = np.asarray(qbin_offsets, np.int64)
+        self._feature_flags = np.asarray(feature_flags)
+        self._sharding = None
+        self._row_multiple = 1
+        if shard:
+            devs = jax.local_devices()
+            if len(devs) > 1:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                from ..parallel import make_mesh
+
+                mesh = make_mesh()
+                self._sharding = NamedSharding(mesh, P("data"))
+                self._replicated = NamedSharding(mesh, P())
+                self._row_multiple = len(devs)
+                class_arrays = [
+                    tuple(jax.device_put(a, self._replicated) for a in args)
+                    for args in class_arrays
+                ]
+        self.class_arrays = [
+            tuple(jnp.asarray(a) for a in args) for args in class_arrays
+        ]
+
+    # -- construction --------------------------------------------------
+    @classmethod
+    def from_qtree_arrays(cls, arrays, num_tree_per_iteration: int,
+                          **kw) -> "BucketedQuantizedPredictor":
+        arrays.validate()
+        arrays = pad_qtree_arrays(arrays)
+        t = arrays.split_feature.shape[0]
+        k = int(num_tree_per_iteration)
+        if k <= 0 or t % k != 0:
+            Log.fatal("%d stacked trees are not a multiple of "
+                      "num_tree_per_iteration=%d", t, k)
+        class_arrays = []
+        for kk in range(k):
+            idx = np.arange(kk, t, k)
+            class_arrays.append(tuple(
+                np.asarray(getattr(arrays, f))[idx]
+                for f in _Q_TREE_ARG_FIELDS
+            ))
+        return cls(class_arrays, arrays.qbin_edges, arrays.qbin_offsets,
+                   arrays.feature_flags, arrays.levels, **kw)
+
+    # -- predict -------------------------------------------------------
+    def bucket(self, n: int) -> int:
+        return bucket_for(n, self.min_bucket, self._row_multiple)
+
+    def _qbins(self, data: np.ndarray, bucket: int):
+        """Host rank-encode ``data`` and pad to ``bucket`` rows (padding
+        rows are all-zero codes; traversal is row-independent and the
+        padding is stripped on return)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.qpredict import quantize_data
+
+        qb = quantize_data(np.asarray(data, np.float64), self._edges,
+                           self._offsets, self._feature_flags)
+        pad = bucket - qb.shape[0]
+        if pad:
+            qb = np.pad(qb, ((0, pad), (0, 0)))
+        qb = jnp.asarray(qb)
+        if self._sharding is not None:
+            qb = jax.device_put(qb, self._sharding)
+        return qb
+
+    def predict_raw_scores(self, data: np.ndarray) -> np.ndarray:
+        """(K, N) float64 raw scores for (N, F) raw features."""
+        n = data.shape[0]
+        bucket = self.bucket(n)
+        qb = self._qbins(data, bucket)
+        fn = _qwatch()
+        out = np.empty((self.num_class_arrays, n))
+        for kk, args in enumerate(self.class_arrays):
+            out[kk] = np.asarray(
+                fn(qb, *args, levels=self.levels), np.float64)[:n]
+        tracer.counter("serve_qpredict_rows", float(n))
+        return out
+
+    # -- warmup --------------------------------------------------------
+    def warmup(self, max_rows: int, num_features: int,
+               buckets: Optional[List[int]] = None) -> Dict:
+        """Precompile the bucket ladder up to ``max_rows`` rows (see
+        ``BucketedRawPredictor.warmup``)."""
         if buckets is None:
             buckets = bucket_ladder(max_rows, self.min_bucket, self._row_multiple)
         c0 = compilewatch.total_compiles()

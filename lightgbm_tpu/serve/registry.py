@@ -13,7 +13,15 @@ processes share:
                        num_trees, num_features, ...,
                        dedupe_key?, quarantined?}},
                        "active_version": int|null,
-                       "canary_version": int|null}
+                       "canary_version": int|null,
+                       "routes": {route_name: version}}
+
+Named routes (multi-model serving, docs/SERVING.md): ``routes`` maps a
+route name (``POST /predict/<route>``) to the version it serves, each
+activated/swapped independently of ``active_version`` (the default
+route) via ``set_route``/``remove_route``.  Retention protects EVERY
+routed version, not just the single active one — N concurrently-active
+tenant models must all survive ``keep_last``.
 
 Lifecycle state beyond "active" (the continuous-training factory,
 docs/FACTORY.md): ``canary_version`` marks a version under canary
@@ -47,6 +55,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import time
 import zlib
 from typing import Dict, List, Optional, Tuple
@@ -62,6 +71,9 @@ _LOCK = ".publish.lock"
 
 LOCK_STALE_S = 30.0
 LOCK_WAIT_S = 10.0
+
+# route names land in URLs and manifest keys: path-safe, no dot-prefix
+_ROUTE_RE = re.compile(r"^(?!\.)[A-Za-z0-9._\-]{1,64}$")
 
 
 def _version_name(version: int) -> str:
@@ -139,10 +151,13 @@ class ModelRegistry:
                 m = json.load(f)
             if isinstance(m, dict) and isinstance(m.get("entries"), dict):
                 m.setdefault("canary_version", None)
+                if not isinstance(m.get("routes"), dict):
+                    m["routes"] = {}
                 return m
         except (OSError, ValueError):
             pass
-        return {"entries": {}, "active_version": None, "canary_version": None}
+        return {"entries": {}, "active_version": None, "canary_version": None,
+                "routes": {}}
 
     def _write_manifest(self, manifest: Dict) -> None:
         _atomic_write(self._manifest_path(),
@@ -276,6 +291,55 @@ class ModelRegistry:
             manifest["active_version"] = int(version)
             self._write_manifest(manifest)
 
+    # -- named routes (multi-model serving, docs/SERVING.md) -----------
+    def set_route(self, route: str, version: int) -> None:
+        """Point route ``route`` (served at ``POST /predict/<route>``)
+        at a published version — creating the route, or independently
+        hot-swapping it if it exists.  Route names are path-safe
+        identifiers; the version must already be published."""
+        route = str(route)
+        if not _ROUTE_RE.match(route):
+            Log.fatal("registry: invalid route name %r (allowed: 1-64 "
+                      "chars of [A-Za-z0-9._-], not starting with '.')",
+                      route)
+        with _PublishLock(self.dir):
+            manifest = self.read_manifest()
+            if not any(int(e["version"]) == int(version)
+                       for e in manifest["entries"].values()):
+                Log.fatal("registry: cannot route %r to unknown version %s "
+                          "(published: %s)", route, version,
+                          sorted(int(e["version"])
+                                 for e in manifest["entries"].values()))
+            manifest["routes"][route] = int(version)
+            self._write_manifest(manifest)
+        from ..obs import tracer
+
+        tracer.event("registry.route_set", route=route, version=int(version))
+
+    def remove_route(self, route: str) -> bool:
+        """Drop a named route (its version stays published, now subject
+        to normal retention).  Returns False when the route did not
+        exist."""
+        with _PublishLock(self.dir):
+            manifest = self.read_manifest()
+            existed = manifest["routes"].pop(str(route), None) is not None
+            if existed:
+                self._write_manifest(manifest)
+        if existed:
+            from ..obs import tracer
+
+            tracer.event("registry.route_removed", route=str(route))
+        return existed
+
+    def routes(self) -> Dict[str, int]:
+        """{route_name: version} for every named route."""
+        return {str(r): int(v)
+                for r, v in self.read_manifest()["routes"].items()}
+
+    def route_version(self, route: str) -> Optional[int]:
+        v = self.read_manifest()["routes"].get(str(route))
+        return int(v) if v is not None else None
+
     # -- canary / quarantine lifecycle (docs/FACTORY.md) ---------------
     def set_canary(self, version: Optional[int]) -> None:
         """Mark ``version`` as under canary evaluation (``None`` clears).
@@ -340,11 +404,15 @@ class ModelRegistry:
         entries = manifest["entries"]
         # retention protects everything a process may still be serving
         # or a human may still need: the active version (replicas drain
-        # onto it), the canary version (a slow observation window must
-        # not lose the model under evaluation), and the most recently
-        # quarantined version (the rollback evidence)
+        # onto it), EVERY routed version (multi-model serving keeps N
+        # versions concurrently active — collecting any routed active
+        # would 404 a live route on its next replica load), the canary
+        # version (a slow observation window must not lose the model
+        # under evaluation), and the most recently quarantined version
+        # (the rollback evidence)
         protected = {manifest.get("active_version"),
                      manifest.get("canary_version")}
+        protected.update(int(v) for v in manifest.get("routes", {}).values())
         quarantined = [int(e["version"]) for e in entries.values()
                        if e.get("quarantined")]
         if quarantined:
@@ -367,6 +435,7 @@ class ModelRegistry:
         manifest = self.read_manifest()
         active = manifest.get("active_version")
         canary = manifest.get("canary_version")
+        routes = manifest.get("routes", {})
         out = []
         for name, e in sorted(manifest["entries"].items(),
                               key=lambda kv: int(kv[1]["version"])):
@@ -377,6 +446,8 @@ class ModelRegistry:
                              if canary is not None else False)
             row["quarantined"] = str(e["quarantined"]) \
                 if e.get("quarantined") else None
+            row["routes"] = sorted(r for r, v in routes.items()
+                                   if int(v) == int(e["version"]))
             out.append(row)
         return out
 
@@ -428,11 +499,17 @@ class ModelRegistry:
     # -- watch ---------------------------------------------------------
     def watch_token(self) -> Tuple:
         """Cheap change token: manifest identity (size + mtime_ns) plus
-        the active version.  Polling replicas reload when it changes —
-        no inotify, works on any filesystem including network mounts."""
+        the active version and the route table.  Polling replicas
+        reload when it changes — no inotify, works on any filesystem
+        including network mounts."""
         try:
             st = os.stat(self._manifest_path())
             ident = (int(st.st_size), int(st.st_mtime_ns))
         except OSError:
             ident = (0, 0)
-        return ident + (self.active_version(),)
+        m = self.read_manifest()
+        active = m.get("active_version")
+        return ident + (
+            int(active) if active is not None else None,
+            tuple(sorted((str(r), int(v)) for r, v in m["routes"].items())),
+        )

@@ -90,6 +90,7 @@ DEFAULTS = {
     "drain_timeout_ms": 10000,
     "registry_poll_ms": 500.0,
     "pin_version": 0,
+    "route_budget_mb": 0.0,
 }
 
 # per-version serving attribution (docs/FACTORY.md): one labeled child
@@ -107,6 +108,26 @@ _M_VER_LATENCY = metrics_registry.labeled_histogram(
     "lightgbm_tpu_serve_version_latency_seconds",
     "predict request latency, split by serving model version")
 
+# per-route attribution (multi-model serving): one labeled child per
+# route currently admitted ("default" is the unnamed /predict route).
+# Families are pruned to the live route set on every route sync, so
+# cardinality stays bounded by what this replica actually serves.
+_M_ROUTE_REQS = metrics_registry.labeled_counter(
+    "lightgbm_tpu_serve_route_requests_total",
+    "predict requests answered, split by model route", label="model_route")
+_M_ROUTE_ERRS = metrics_registry.labeled_counter(
+    "lightgbm_tpu_serve_route_errors_total",
+    "failed predict requests (500/503/504), split by model route",
+    label="model_route")
+_M_ROUTE_LATENCY = metrics_registry.labeled_histogram(
+    "lightgbm_tpu_serve_route_latency_seconds",
+    "predict request latency, split by model route", label="model_route")
+_M_ADMISSION_REFUSED = metrics_registry.counter(
+    "lightgbm_tpu_serve_admission_refused_total",
+    "route admissions refused by the device-bytes budget")
+
+_DEFAULT_ROUTE = "default"
+
 
 def load_artifact(model_path: str) -> PredictorArtifact:
     """Load a packed ``.npz`` artifact, or pack a model text file."""
@@ -121,11 +142,19 @@ def make_predictor(artifact: PredictorArtifact,
                    shard: bool = False) -> PackedPredictor:
     predictor = PackedPredictor(artifact)
     if shard:
-        from .compilecache import BucketedRawPredictor
+        if predictor.quantized:
+            from .compilecache import BucketedQuantizedPredictor
 
-        predictor.raw = BucketedRawPredictor.from_tree_arrays(
-            artifact.arrays, artifact.num_tree_per_iteration, shard=True
-        )
+            predictor.raw = BucketedQuantizedPredictor.from_qtree_arrays(
+                predictor.artifact.arrays,
+                predictor.artifact.num_tree_per_iteration, shard=True
+            )
+        else:
+            from .compilecache import BucketedRawPredictor
+
+            predictor.raw = BucketedRawPredictor.from_tree_arrays(
+                artifact.arrays, artifact.num_tree_per_iteration, shard=True
+            )
     return predictor
 
 
@@ -158,6 +187,27 @@ def _parse_rows(body: bytes) -> np.ndarray:
     return np.asarray(rows, np.float64)
 
 
+class _RouteSlot:
+    """One admitted named route: its hot-swap slot + its own batcher
+    pair, sharing the process-wide bucketed compile cache with every
+    other route (same-shape models share every XLA program)."""
+
+    __slots__ = ("route", "swapper", "batcher", "raw_batcher")
+
+    def __init__(self, route: str, swapper, batcher_opts: Dict):
+        self.route = route
+        self.swapper = swapper
+        self.batcher = MicroBatcher(
+            lambda batch: swapper.predict(batch), **batcher_opts)
+        self.raw_batcher = MicroBatcher(
+            lambda batch: swapper.predict(batch, raw_score=True),
+            **batcher_opts)
+
+    def close(self) -> None:
+        self.batcher.close()
+        self.raw_batcher.close()
+
+
 class PredictServer(ThreadingHTTPServer):
     """HTTP server owning the predictor + batcher; ``daemon_threads`` so
     in-flight handler threads never block shutdown."""
@@ -169,12 +219,15 @@ class PredictServer(ThreadingHTTPServer):
                  registry: Optional[ModelRegistry] = None,
                  registry_poll_ms: float = 500.0,
                  warmup_max_rows: int = 4096, do_warmup: bool = True,
-                 pin_version: Optional[int] = None):
+                 pin_version: Optional[int] = None,
+                 route_budget_bytes: int = 0,
+                 predictor_factory=None):
         self.predictor = predictor
         # pinned replicas (canary) serve exactly one version: no
         # watcher, and maybe_swap is a no-op even on POST /models
         self.pin_version = int(pin_version) if pin_version else None
         opts = dict(batcher_opts or {})
+        self._batcher_opts = opts
         self.batcher = MicroBatcher(
             lambda batch: predictor.predict(batch),
             **opts,
@@ -183,6 +236,14 @@ class PredictServer(ThreadingHTTPServer):
             lambda batch: predictor.predict(batch, raw_score=True),
             **opts,
         )
+        # multi-model: named routes from the registry's route table,
+        # each a _RouteSlot admitted against the device-bytes budget
+        # (0 = unlimited); refused routes answer 503 with the reason
+        self.routes: Dict[str, _RouteSlot] = {}
+        self.route_budget_bytes = max(0, int(route_budget_bytes))
+        self.admission_refused: Dict[str, str] = {}
+        self._route_lock = threading.Lock()
+        self._predictor_factory = predictor_factory or PackedPredictor
         self.registry = registry
         self.registry_poll_ms = float(registry_poll_ms)
         self._warmup_max_rows = int(warmup_max_rows)
@@ -256,6 +317,87 @@ class PredictServer(ThreadingHTTPServer):
                 fam.prune({str(target)})
             return stats
 
+    # -- multi-model routes --------------------------------------------
+    def device_bytes_used(self) -> int:
+        """Device-resident tree bytes across the default predictor and
+        every admitted route — the admission accounting base."""
+        used = int(getattr(self.predictor, "predictor",
+                           self.predictor).device_bytes)
+        for slot in self.routes.values():
+            used += int(slot.swapper.predictor.device_bytes)
+        return used
+
+    def sync_routes(self) -> Optional[Dict]:
+        """Reconcile the served route slots against the registry's route
+        table: admit new routes (against the device-bytes budget),
+        independently hot-swap routes whose version moved, tear down
+        removed routes (and prune their metric children).  Returns a
+        summary dict, or None when not in registry mode."""
+        if self.registry is None or self.pin_version is not None:
+            return None
+        with self._route_lock:
+            want = self.registry.routes()
+            for name in list(self.routes):
+                if name not in want:
+                    slot = self.routes.pop(name)
+                    slot.close()
+                    self.admission_refused.pop(name, None)
+                    tracer.event("serve.route_removed", route=name)
+            for name, version in sorted(want.items()):
+                slot = self.routes.get(name)
+                try:
+                    if slot is not None:
+                        if slot.swapper.version != version:
+                            artifact = self.registry.load(version)
+                            slot.swapper.swap_to(
+                                artifact, version,
+                                warmup_max_rows=self._warmup_max_rows,
+                                do_warmup=self._do_warmup)
+                        continue
+                    artifact = self.registry.load(version)
+                    need = artifact.device_bytes_estimate()
+                    used = self.device_bytes_used()
+                    budget = self.route_budget_bytes
+                    if budget and used + need > budget:
+                        reason = (
+                            f"route {name!r} (v{version}) needs {need} "
+                            f"device bytes but {used} of the {budget}-byte "
+                            f"budget are in use — remove a route or raise "
+                            f"route_budget_mb")
+                        if self.admission_refused.get(name) != reason:
+                            Log.warning("serve: ADMISSION REFUSED: %s",
+                                        reason)
+                            _M_ADMISSION_REFUSED.inc()
+                            tracer.event("serve.route_refused", route=name,
+                                         version=int(version),
+                                         need_bytes=int(need),
+                                         used_bytes=int(used),
+                                         budget_bytes=int(budget))
+                        self.admission_refused[name] = reason
+                        continue
+                    swapper = SwappablePredictor(
+                        self._predictor_factory(artifact), version=version)
+                    if self._do_warmup:
+                        swapper.warmup(self._warmup_max_rows)
+                    self.routes[name] = _RouteSlot(name, swapper,
+                                                   self._batcher_opts)
+                    self.admission_refused.pop(name, None)
+                    tracer.event("serve.route_added", route=name,
+                                 version=int(version),
+                                 device_bytes=int(
+                                     swapper.predictor.device_bytes))
+                except LightGBMError as e:
+                    # a torn publish/corrupt artifact on ONE route must
+                    # not take down the others — skip and retry on the
+                    # next registry change
+                    Log.warning("serve: route %r sync failed: %s", name, e)
+            live = set(self.routes) | {_DEFAULT_ROUTE}
+            for fam in (_M_ROUTE_REQS, _M_ROUTE_ERRS, _M_ROUTE_LATENCY):
+                fam.prune(live)
+            return {"routes": {n: s.swapper.version
+                               for n, s in self.routes.items()},
+                    "refused": dict(self.admission_refused)}
+
     def start_registry_watcher(self) -> None:
         """Poll the registry's change token and swap on activation —
         inotify-free, so it works on any shared filesystem."""
@@ -280,6 +422,10 @@ class PredictServer(ThreadingHTTPServer):
                     Log.warning("serve: registry swap failed (still on "
                                 "v%s): %s", getattr(self.predictor,
                                                     "version", "?"), e)
+                try:
+                    self.sync_routes()
+                except Exception as e:
+                    Log.warning("serve: route sync failed: %s", e)
 
         self._watch_thread = threading.Thread(
             target=_loop, name="ltpu-registry-watch", daemon=True)
@@ -316,7 +462,10 @@ class PredictServer(ThreadingHTTPServer):
             drained = self._inflight == 0
         # settle the batchers too: every queued AND executing row must
         # reach zero before the drain counts as complete
-        for b in (self.batcher, self.raw_batcher):
+        batchers = [self.batcher, self.raw_batcher]
+        for slot in list(self.routes.values()):
+            batchers += [slot.batcher, slot.raw_batcher]
+        for b in batchers:
             remaining = max(0.0, deadline - time.monotonic())
             drained = b.drain(remaining) and drained
         if not drained:
@@ -352,9 +501,33 @@ class PredictServer(ThreadingHTTPServer):
                           "latency_p50_ms": 0.0, "latency_p99_ms": 0.0}
         return out
 
+    def route_stats(self) -> Dict[str, Dict]:
+        """Per-route serving attribution — the JSON parity view of the
+        ``model_route``-labeled ``/metrics`` families (same counters,
+        same histogram), pinned by tests/test_fleet.py."""
+        out: Dict[str, Dict] = {}
+        lat = _M_ROUTE_LATENCY.children()
+        errs = _M_ROUTE_ERRS.children()
+        for r, c in _M_ROUTE_REQS.children().items():
+            h = lat.get(r)
+            out[r] = {
+                "requests": int(c.value()),
+                "errors": int(errs[r].value()) if r in errs else 0,
+                "latency_p50_ms":
+                    round(h.quantile(0.5) * 1e3, 3) if h else 0.0,
+                "latency_p99_ms":
+                    round(h.quantile(0.99) * 1e3, 3) if h else 0.0,
+            }
+        for r, c in errs.items():
+            if r not in out:
+                out[r] = {"requests": 0, "errors": int(c.value()),
+                          "latency_p50_ms": 0.0, "latency_p99_ms": 0.0}
+        return out
+
     def stats(self) -> Dict:
         cw = compilewatch.snapshot()
         watched = cw["watched"].get("serve.predict_raw", {})
+        qwatched = cw["watched"].get("serve.qpredict", {})
         out = {
             "uptime_s": round(time.time() - self.t_start, 1),
             "ready": self.ready,
@@ -373,8 +546,31 @@ class PredictServer(ThreadingHTTPServer):
                 "predict_calls": watched.get("calls", 0),
                 "predict_compiles": watched.get("compiles", 0),
                 "predict_retraces": watched.get("retraces", 0),
+                "qpredict_calls": qwatched.get("calls", 0),
+                "qpredict_compiles": qwatched.get("compiles", 0),
+                "qpredict_retraces": qwatched.get("retraces", 0),
             },
         }
+        if self.routes or self.admission_refused or self.route_budget_bytes:
+            with self._route_lock:
+                out["routes"] = {
+                    name: {
+                        "version": slot.swapper.version,
+                        "quantized": bool(getattr(
+                            slot.swapper.predictor, "quantized", False)),
+                        "device_bytes": getattr(
+                            slot.swapper.predictor, "device_bytes", 0),
+                        "swaps": slot.swapper.swaps,
+                        "batcher": slot.batcher.stats(),
+                    }
+                    for name, slot in self.routes.items()
+                }
+            out["per_route"] = self.route_stats()
+            out["admission"] = {
+                "budget_bytes": self.route_budget_bytes,
+                "used_bytes": self.device_bytes_used(),
+                "refused": dict(self.admission_refused),
+            }
         if isinstance(self.predictor, SwappablePredictor):
             out["swap"] = {
                 "swaps": self.predictor.swaps,
@@ -394,6 +590,8 @@ class PredictServer(ThreadingHTTPServer):
         super().shutdown()
         self.batcher.close()
         self.raw_batcher.close()
+        for slot in list(self.routes.values()):
+            slot.close()
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -441,7 +639,10 @@ class _Handler(BaseHTTPRequestHandler):
                     "active_version": self.server.registry.active_version(),
                     "serving_version": getattr(self.server.predictor,
                                                "version", None),
+                    "routes": self.server.registry.routes(),
                 })
+        elif self.path == "/routes":
+            self._do_routes_get()
         elif self.path == "/metrics":
             # Prometheus text format; render() never touches jax, so a
             # scrape storm cannot compile or serialize device work
@@ -455,7 +656,13 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/models":
             self._do_publish()
             return
-        if path != "/predict":
+        if path == "/routes":
+            self._do_routes_post()
+            return
+        route = None
+        if path.startswith("/predict/"):
+            route = path[len("/predict/"):]
+        elif path != "/predict":
             self._reply_json(404, {"error": f"unknown path {path}"})
             return
         if self.server.draining or self.server.drained:
@@ -465,9 +672,73 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self.server.track_begin()
         try:
-            self._do_predict(query)
+            self._do_predict(query, route=route)
         finally:
             self.server.track_end()
+
+    def _do_routes_get(self) -> None:
+        """GET /routes: the live route table (what THIS replica serves)
+        plus the admission ledger — budget, usage, and refusals."""
+        with self.server._route_lock:
+            table = {name: {"version": slot.swapper.version,
+                            "quantized": bool(getattr(
+                                slot.swapper.predictor, "quantized", False)),
+                            "device_bytes": getattr(
+                                slot.swapper.predictor, "device_bytes", 0)}
+                     for name, slot in self.server.routes.items()}
+        self._reply_json(200, {
+            "routes": table,
+            "registry_routes": (self.server.registry.routes()
+                                if self.server.registry is not None else {}),
+            "admission": {
+                "budget_bytes": self.server.route_budget_bytes,
+                "used_bytes": self.server.device_bytes_used(),
+                "refused": dict(self.server.admission_refused),
+            },
+        })
+
+    def _do_routes_post(self) -> None:
+        """POST /routes admin endpoint (registry mode only).
+
+        ``{"route": name, "version": v}`` binds the route to a published
+        version; ``{"route": name, "remove": true}`` unbinds it.  Either
+        way the local reconciler runs synchronously so the reply reflects
+        this replica's actual serving state (other replicas converge via
+        their registry watcher).
+        """
+        if self.server.registry is None:
+            self._reply_json(404, {"error": "no model registry "
+                                            "(start with registry=dir)"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(length) or b"{}")
+            route = str(body["route"])
+        except (ValueError, KeyError, json.JSONDecodeError) as e:
+            self._reply_json(400, {"error": f"bad request body: {e}"})
+            return
+        try:
+            if body.get("remove"):
+                removed = self.server.registry.remove_route(route)
+                if not removed:
+                    self._reply_json(404,
+                                     {"error": f"unknown route {route!r}"})
+                    return
+            else:
+                self.server.registry.set_route(route, int(body["version"]))
+        except (LightGBMError, TimeoutError, KeyError, ValueError) as e:
+            self._reply_json(400, {"error": str(e)})
+            return
+        sync = None
+        try:
+            sync = self.server.sync_routes()
+        except Exception as e:
+            Log.warning("serve: route sync after POST /routes failed: %s", e)
+        self._reply_json(200, {
+            "route": route,
+            "registry_routes": self.server.registry.routes(),
+            "sync": sync,
+        })
 
     def _do_publish(self) -> None:
         """POST /models: validate + publish the uploaded artifact bytes,
@@ -501,42 +772,64 @@ class _Handler(BaseHTTPRequestHandler):
             "swap": swap,
         })
 
-    def _count_error(self) -> None:
+    def _count_error(self, route: Optional[str] = None) -> None:
         # a failed request never reached a batch, so it is attributed
         # to the version currently serving
         _M_VER_ERRS.labels(
             getattr(self.server.predictor, "version", 0)).inc()
+        _M_ROUTE_ERRS.labels(route if route is not None else
+                             _DEFAULT_ROUTE).inc()
 
-    def _do_predict(self, query: str) -> None:
+    def _do_predict(self, query: str, route: Optional[str] = None) -> None:
         raw_score = "raw_score=1" in query
         stamp_version = "model_version=1" in query
+        if route is None:
+            batcher_pair = (self.server.batcher, self.server.raw_batcher)
+        else:
+            with self.server._route_lock:
+                slot = self.server.routes.get(route)
+                refused = self.server.admission_refused.get(route)
+            if slot is None:
+                if refused is not None:
+                    # admitted-by-name but not by budget: loud, actionable
+                    self._reply_json(503, {"error": f"route {route!r} "
+                                           f"refused admission: {refused}"})
+                else:
+                    self._reply_json(404,
+                                     {"error": f"unknown route {route!r}"})
+                return
+            batcher_pair = (slot.batcher, slot.raw_batcher)
+        batcher = batcher_pair[1] if raw_score else batcher_pair[0]
+        route_label = route if route is not None else _DEFAULT_ROUTE
         try:
             length = int(self.headers.get("Content-Length") or 0)
             rows = _parse_rows(self.rfile.read(length))
         except (ValueError, json.JSONDecodeError) as e:
             self._reply_json(400, {"error": str(e)})
             return
-        batcher = self.server.raw_batcher if raw_score else self.server.batcher
         t0 = time.monotonic()
         try:
             preds, version = batcher.submit_ex(rows)
         except ServerOverloaded as e:
-            self._count_error()
+            self._count_error(route)
             self._reply_json(503, {"error": str(e)})
             return
         except RequestTimeout as e:
-            self._count_error()
+            self._count_error(route)
             self._reply_json(504, {"error": str(e)})
             return
         except Exception as e:
             Log.warning("serve: predict failed: %s", e)
-            self._count_error()
+            self._count_error(route)
             self._reply_json(500, {"error": f"{type(e).__name__}: {e}"})
             return
         # attribute the request to the ONE version that answered it —
-        # the same version the X-Model-Version header carries
+        # the same version the X-Model-Version header carries — and to
+        # the route the caller addressed ("default" for bare /predict)
         _M_VER_REQS.labels(version).inc()
         _M_VER_LATENCY.labels(version).observe(time.monotonic() - t0)
+        _M_ROUTE_REQS.labels(route_label).inc()
+        _M_ROUTE_LATENCY.labels(route_label).observe(time.monotonic() - t0)
 
         def _plain(p):
             return p.tolist() if isinstance(p, np.ndarray) else float(p)
@@ -549,6 +842,8 @@ class _Handler(BaseHTTPRequestHandler):
             lines = [json.dumps(_plain(p)) for p in preds]
         headers = ([("X-Model-Version", int(version))]
                    if version is not None else [])
+        if route is not None:
+            headers.append(("X-Model-Route", route))
         self._reply(200, ("\n".join(lines) + "\n").encode(),
                     ctype="application/jsonl", extra_headers=headers)
 
@@ -559,6 +854,7 @@ def make_server(model_path: Optional[str] = None, host: str = "127.0.0.1",
                 registry_dir: Optional[str] = None,
                 registry_poll_ms: float = 500.0,
                 pin_version: Optional[int] = None,
+                route_budget_mb: float = 0.0,
                 **batcher_opts) -> PredictServer:
     """Build (and optionally warm) a ready-to-run server; ``port=0``
     binds an ephemeral port (tests).  With ``registry_dir`` the server
@@ -595,11 +891,15 @@ def make_server(model_path: Optional[str] = None, host: str = "127.0.0.1",
                            registry_poll_ms=registry_poll_ms,
                            warmup_max_rows=warmup_max_rows,
                            do_warmup=do_warmup,
-                           pin_version=pin_version)
+                           pin_version=pin_version,
+                           route_budget_bytes=int(route_budget_mb * (1 << 20)),
+                           predictor_factory=lambda art: make_predictor(
+                               art, shard=shard))
     if do_warmup:
         stats = swapper.warmup(warmup_max_rows)
         Log.info("serve: warmup compiled %d programs over buckets %s in %.2fs",
                  stats["compiles"], stats["buckets"], stats["secs"])
+    server.sync_routes()  # admit named routes before advertising ready
     server.ready = True  # artifact loaded + warmup complete -> /readyz 200
     if registry is not None:
         server.start_registry_watcher()
@@ -632,6 +932,7 @@ def main(argv: List[str]) -> int:
         registry_dir=registry_dir,
         registry_poll_ms=float(opts["registry_poll_ms"]),
         pin_version=int(opts["pin_version"]) or None,
+        route_budget_mb=float(opts["route_budget_mb"]),
         max_batch_size=int(opts["max_batch_size"]),
         max_delay_ms=float(opts["max_delay_ms"]),
         max_queue_rows=int(opts["max_queue_rows"]),

@@ -188,3 +188,84 @@ def test_factory_section_error_never_gates(tmp_path):
                        "rows": 8_000, "num_boost_round": 10}}
     assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
     assert "gate_factory" not in out
+
+
+# ----------------------------------------------------------------------
+# quantized-serving leg
+# ----------------------------------------------------------------------
+def _quantized(speedup=2.0, swap_compiles=0, within_bound=True, ratio=2.5):
+    return {
+        "artifact_bytes": {"payload_ratio": ratio},
+        "drift": {"max_abs": 1e-4, "bound": 1e-3,
+                  "within_bound": within_bound},
+        "batch2048": {"exact": {"rows_per_s": 1e6},
+                      "quantized": {"rows_per_s": 1e6 * speedup},
+                      "speedup": speedup},
+        "swap": {"swaps": 3, "swap_latency_p50_ms": 1.0,
+                 "swap_new_compiles": swap_compiles},
+    }
+
+
+def test_quantized_swap_compiles_gate_fires_without_prior(tmp_path):
+    out = {"metric": METRIC, "value": 0.10,
+           "quantized": _quantized(swap_compiles=2)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 1
+    assert out["regression_quant_swap_compiles"] is True
+
+
+def test_quantized_drift_gate_fires_without_prior(tmp_path):
+    out = {"metric": METRIC, "value": 0.10,
+           "quantized": _quantized(within_bound=False)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 1
+    assert out["regression_quant_drift"] is True
+
+
+def test_quantized_bytes_gate_fires_without_prior(tmp_path):
+    out = {"metric": METRIC, "value": 0.10,
+           "quantized": _quantized(ratio=1.4)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 1
+    assert out["regression_quant_bytes"] is True
+
+
+def test_quantized_speedup_gates_against_prior(tmp_path):
+    _capture(tmp_path, "BENCH_r01.json", 0.10, quantized=_quantized(2.0))
+    out = {"metric": METRIC, "value": 0.10, "quantized": _quantized(1.5)}
+    rc = bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={})
+    assert rc == 1
+    assert out["regression_quantized"] is True
+    assert out["gate_quantized"]["best_prior_speedup_batch2048"] == 2.0
+    # within the 1.10 band passes
+    out = {"metric": METRIC, "value": 0.10, "quantized": _quantized(1.85)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+    assert "regression_quantized" not in out
+
+
+def test_quantized_section_error_never_gates(tmp_path):
+    out = {"metric": METRIC, "value": 0.10,
+           "quantized": {"error": "RuntimeError: boom",
+                         "swap": {"swap_new_compiles": 9}}}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+
+
+def test_quantized_clean_run_passes(tmp_path):
+    out = {"metric": METRIC, "value": 0.10, "quantized": _quantized()}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+    for k in list(out):
+        assert not k.startswith("regression"), k
+
+
+# ----------------------------------------------------------------------
+# multi-model leg
+# ----------------------------------------------------------------------
+def test_multimodel_admission_gate(tmp_path):
+    out = {"metric": METRIC, "value": 0.10,
+           "multimodel": {"n_models": 4, "admission_refusal_ok": False}}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 1
+    assert out["regression_multimodel_admission"] is True
+    out = {"metric": METRIC, "value": 0.10,
+           "multimodel": {"n_models": 4, "admission_refusal_ok": True}}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+    out = {"metric": METRIC, "value": 0.10,
+           "multimodel": {"error": "RuntimeError: boom",
+                          "admission_refusal_ok": False}}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0

@@ -221,6 +221,68 @@ class TestRegistry:
         assert reg.seed(_retrain_artifact(art, 1.1)) == 1
         assert [m["version"] for m in reg.list_models()] == [1]
 
+    def test_routes_set_remove_and_watch_token(self, binary_booster,
+                                               tmp_path):
+        bst, _ = binary_booster
+        art = PredictorArtifact.from_booster(bst)
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        v1 = reg.publish(art)
+        v2 = reg.publish(_retrain_artifact(art, 1.1), activate=False)
+        assert reg.routes() == {}
+        t0 = reg.watch_token()
+        reg.set_route("shadow", v2)
+        assert reg.routes() == {"shadow": v2}
+        assert reg.route_version("shadow") == v2
+        assert reg.watch_token() != t0  # replicas must see route changes
+        # independent re-point (per-route hot swap)
+        reg.set_route("shadow", v1)
+        assert reg.route_version("shadow") == v1
+        # list_models surfaces which routes serve each version
+        by_ver = {m["version"]: m for m in reg.list_models()}
+        assert by_ver[v1]["routes"] == ["shadow"]
+        assert by_ver[v2]["routes"] == []
+        t1 = reg.watch_token()
+        assert reg.remove_route("shadow") is True
+        assert reg.remove_route("shadow") is False
+        assert reg.watch_token() != t1
+        assert reg.route_version("shadow") is None
+
+    def test_route_validation(self, binary_booster, tmp_path):
+        bst, _ = binary_booster
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        v = reg.publish(PredictorArtifact.from_booster(bst))
+        with pytest.raises(LightGBMError, match="unknown version"):
+            reg.set_route("r", 99)
+        for bad in ("", "a/b", "..", ".hidden", "x" * 65, "a b"):
+            with pytest.raises(LightGBMError, match="invalid route name"):
+                reg.set_route(bad, v)
+
+    def test_gc_never_collects_any_routed_version(self, binary_booster,
+                                                  tmp_path):
+        """Multi-model retention: EVERY routed version is a live serving
+        dependency and must survive GC, no matter how old — collecting
+        one would 404 the route on its next replica load."""
+        bst, _ = binary_booster
+        art = PredictorArtifact.from_booster(bst)
+        reg = ModelRegistry(str(tmp_path / "reg"), keep_last=2)
+        v1 = reg.publish(art)
+        v2 = reg.publish(_retrain_artifact(art, 1.1), activate=False)
+        reg.set_route("a", v1)
+        reg.set_route("b", v2)
+        # churn far past keep_last: v1/v2 are the OLDEST versions and
+        # would be collected first were routes not protected
+        for i in range(5):
+            reg.publish(_retrain_artifact(art, 2.0 + i))
+        versions = [m["version"] for m in reg.list_models()]
+        assert v1 in versions and v2 in versions
+        reg.load(v1)  # artifacts really are still on disk + CRC-clean
+        reg.load(v2)
+        # dropping a route releases its version to normal retention
+        reg.remove_route("a")
+        reg.publish(_retrain_artifact(art, 9.0))
+        versions = [m["version"] for m in reg.list_models()]
+        assert v1 not in versions and v2 in versions
+
     def test_orphan_file_never_overwritten(self, binary_booster, tmp_path):
         """A crashed publisher's orphan data file (no manifest entry)
         must not be clobbered by version-number reuse."""
@@ -576,6 +638,193 @@ class TestServerRegistryMode:
 
 
 # ----------------------------------------------------------------------
+# multi-model serving: named routes + admission control (in-process)
+# ----------------------------------------------------------------------
+class TestServerMultiModel:
+    @pytest.fixture()
+    def packed(self, binary_booster, tmp_path):
+        """A server packing 4 models on one device: the default route
+        plus 3 named routes, one of them quantized-flavor."""
+        from lightgbm_tpu.serve.server import make_server
+
+        bst, X = binary_booster
+        art = PredictorArtifact.from_booster(bst)
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        v1 = reg.publish(art)
+        v2 = reg.publish(_retrain_artifact(art, 1.5), activate=False)
+        v3 = reg.publish(_retrain_artifact(art, 0.5), activate=False)
+        vq = reg.publish(PredictorArtifact.from_booster(bst, quantized=True),
+                         activate=False)
+        reg.set_route("retrain", v2)
+        reg.set_route("rollback", v3)
+        reg.set_route("quant", vq)
+        srv = make_server(registry_dir=reg.dir, port=0, warmup_max_rows=64,
+                          max_delay_ms=1.0, registry_poll_ms=50.0)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        yield srv, reg, bst, X, {"v1": v1, "v2": v2, "v3": v3, "vq": vq}
+        srv.shutdown()
+        srv.server_close()
+
+    def _post(self, port, path, rows):
+        body = "\n".join(json.dumps(list(map(float, r))) for r in rows).encode()
+        return urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", data=body, timeout=30)
+
+    def _get_json(self, port, path):
+        return json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30).read())
+
+    def test_four_models_pack_and_answer_independently(self, packed):
+        srv, reg, bst, X, v = packed
+        port = srv.server_address[1]
+        art = PredictorArtifact.from_booster(bst)
+        rows = X[:6]
+        want = {
+            "/predict": (PackedPredictor(art).predict(rows), v["v1"], None),
+            "/predict/retrain": (
+                PackedPredictor(_retrain_artifact(art, 1.5)).predict(rows),
+                v["v2"], "retrain"),
+            "/predict/rollback": (
+                PackedPredictor(_retrain_artifact(art, 0.5)).predict(rows),
+                v["v3"], "rollback"),
+            "/predict/quant": (
+                PackedPredictor(art.quantize()).predict(rows),
+                v["vq"], "quant"),
+        }
+        for path, (expect, ver, route) in want.items():
+            r = self._post(port, path, rows)
+            assert r.headers["X-Model-Version"] == str(ver), path
+            assert r.headers.get("X-Model-Route") == route, path
+            got = [json.loads(l) for l in r.read().decode().splitlines()]
+            assert np.allclose(got, expect), path
+        table = self._get_json(port, "/routes")
+        assert set(table["routes"]) == {"retrain", "rollback", "quant"}
+        assert table["routes"]["quant"]["quantized"] is True
+        assert table["routes"]["retrain"]["quantized"] is False
+        # the quantized model packs smaller on device
+        assert table["routes"]["quant"]["device_bytes"] * 2 \
+            <= table["routes"]["retrain"]["device_bytes"]
+        assert table["admission"]["used_bytes"] > 0
+
+    def test_unknown_route_404(self, packed):
+        srv, *_ = packed
+        port = srv.server_address[1]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post(port, "/predict/nope", [[0.0] * 12])
+        assert ei.value.code == 404
+
+    def test_admission_refusal_is_loud_and_recovers(self, packed):
+        srv, reg, bst, X, v = packed
+        port = srv.server_address[1]
+        # shrink the budget below what another model needs and route it
+        srv.route_budget_bytes = srv.device_bytes_used() + 1
+        reg.set_route("overflow", v["v2"])
+        srv.sync_routes()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post(port, "/predict/overflow", X[:2])
+        assert ei.value.code == 503
+        assert "refused admission" in ei.value.read().decode()
+        table = self._get_json(port, "/routes")
+        assert "overflow" in table["admission"]["refused"]
+        assert "route_budget_mb" in table["admission"]["refused"]["overflow"]
+        # existing routes keep serving through the refusal
+        self._post(port, "/predict/retrain", X[:2])
+        # raising the budget admits the route on the next sync
+        srv.route_budget_bytes = 0
+        srv.sync_routes()
+        r = self._post(port, "/predict/overflow", X[:2])
+        assert r.headers["X-Model-Route"] == "overflow"
+        assert "overflow" not in self._get_json(
+            port, "/routes")["admission"]["refused"]
+        reg.remove_route("overflow")
+        srv.sync_routes()
+
+    def test_route_swap_follows_registry(self, packed):
+        srv, reg, bst, X, v = packed
+        port = srv.server_address[1]
+        reg.set_route("retrain", v["v3"])  # re-point an existing route
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            r = self._post(port, "/predict/retrain", X[:2])
+            if r.headers["X-Model-Version"] == str(v["v3"]):
+                break
+            time.sleep(0.05)
+        assert r.headers["X-Model-Version"] == str(v["v3"])
+        reg.set_route("retrain", v["v2"])
+
+    def test_per_route_stats_match_metrics(self, packed):
+        """/stats per_route and the model_route-labeled /metrics families
+        are the same counters — the parity contract."""
+        srv, reg, bst, X, v = packed
+        port = srv.server_address[1]
+        for path in ("/predict", "/predict/retrain", "/predict/retrain",
+                     "/predict/quant"):
+            self._post(port, path, X[:2])
+        st = srv.stats()
+        per_route = st["per_route"]
+        assert per_route["retrain"]["requests"] >= 2
+        assert per_route["quant"]["requests"] >= 1
+        assert per_route["default"]["requests"] >= 1
+        assert st["routes"]["quant"]["quantized"] is True
+        assert st["admission"]["used_bytes"] > 0
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30).read().decode()
+        scraped = {}
+        for line in text.splitlines():
+            if line.startswith("lightgbm_tpu_serve_route_requests_total{"):
+                label, val = line.split("} ")
+                scraped[label.split('"')[1]] = int(float(val))
+        for route, s in per_route.items():
+            assert scraped.get(route) == s["requests"], (route, scraped)
+
+    def test_removed_route_prunes_metrics(self, packed):
+        srv, reg, bst, X, v = packed
+        port = srv.server_address[1]
+        reg.set_route("ephemeral", v["v2"])
+        srv.sync_routes()
+        self._post(port, "/predict/ephemeral", X[:2])
+        assert "ephemeral" in srv.stats()["per_route"]
+        reg.remove_route("ephemeral")
+        srv.sync_routes()
+        assert "ephemeral" not in srv.stats()["per_route"]
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30).read().decode()
+        assert 'model_route="ephemeral"' not in text
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post(port, "/predict/ephemeral", X[:2])
+        assert ei.value.code == 404
+
+    def test_routes_admin_endpoint(self, packed):
+        srv, reg, bst, X, v = packed
+        port = srv.server_address[1]
+        body = json.dumps({"route": "viahttp", "version": v["v3"]}).encode()
+        r = urllib.request.urlopen(f"http://127.0.0.1:{port}/routes",
+                                   data=body, timeout=60)
+        reply = json.loads(r.read())
+        assert reply["registry_routes"]["viahttp"] == v["v3"]
+        assert reply["sync"]["routes"]["viahttp"] == v["v3"]
+        r = self._post(port, "/predict/viahttp", X[:2])
+        assert r.headers["X-Model-Route"] == "viahttp"
+        # bad requests are refused without touching the manifest
+        for bad in (b"{}", b'{"route": "x", "version": 99}',
+                    b'{"route": "a/b", "version": 1}'):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"http://127.0.0.1:{port}/routes",
+                                       data=bad, timeout=30)
+            assert ei.value.code == 400
+        body = json.dumps({"route": "viahttp", "remove": True}).encode()
+        r = urllib.request.urlopen(f"http://127.0.0.1:{port}/routes",
+                                   data=body, timeout=60)
+        assert "viahttp" not in json.loads(r.read())["registry_routes"]
+        body = json.dumps({"route": "viahttp", "remove": True}).encode()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/routes",
+                                   data=body, timeout=30)
+        assert ei.value.code == 404
+
+
+# ----------------------------------------------------------------------
 # multi-replica fleet (subprocess replicas + proxy)
 # ----------------------------------------------------------------------
 def _spawn_fleet(registry_dir, n=2):
@@ -598,11 +847,13 @@ def _spawn_fleet(registry_dir, n=2):
     return procs
 
 
-def _closed_loop(port, rows, expected, duration_s, n_threads=4):
+def _closed_loop(port, rows, expected, duration_s, n_threads=4, route=None):
     """Drive closed-loop traffic through the proxy; every reply must be
     200 and stamped with exactly one KNOWN version whose predictions it
-    matches.  Returns (responses, errors, versions_seen, latencies)."""
+    matches.  ``route`` targets ``/predict/<route>`` (multi-model).
+    Returns (responses, errors, versions_seen, latencies)."""
     body = "\n".join(json.dumps(list(map(float, r))) for r in rows).encode()
+    path = "/predict" if route is None else f"/predict/{route}"
     stop = time.monotonic() + duration_s
     lock = threading.Lock()
     stats = {"n": 0, "errors": [], "versions": set(), "lat": []}
@@ -612,7 +863,7 @@ def _closed_loop(port, rows, expected, duration_s, n_threads=4):
             t0 = time.perf_counter()
             try:
                 r = urllib.request.urlopen(
-                    f"http://127.0.0.1:{port}/predict?model_version=1",
+                    f"http://127.0.0.1:{port}{path}?model_version=1",
                     data=body, timeout=60)
                 lines = [json.loads(l)
                          for l in r.read().decode().splitlines()]
@@ -694,6 +945,75 @@ class TestFleetSmoke:
             st = json.loads(urllib.request.urlopen(
                 f"http://127.0.0.1:{procs[1][1]}/stats", timeout=30).read())
             assert st["model_version"] == 2
+        finally:
+            proxy.shutdown()
+            proxy.server_close()
+            for p, _ in procs:
+                p.kill()
+                p.wait(timeout=30)
+
+
+@pytest.mark.fleet
+class TestMultiModelFleetSmoke:
+    """Tier-1 smoke for multi-model serving: 2 subprocess replicas each
+    packing 2 models (default route + a quantized named route) behind
+    the proxy, with one quantized hot swap under live closed-loop
+    traffic on BOTH routes — zero dropped or mis-versioned responses."""
+
+    def test_two_model_routes_and_quantized_swap(self, binary_booster,
+                                                 tmp_path):
+        bst, X = binary_booster
+        art = PredictorArtifact.from_booster(bst)
+        quant1 = art.quantize()
+        quant2 = _retrain_artifact(art, 1.75).quantize()
+        rows = X[:2]
+        expected_default = {1: PackedPredictor(art).predict(rows)}
+        expected_q = {
+            2: PackedPredictor(quant1).predict(rows),
+            4: PackedPredictor(quant2).predict(rows),
+        }
+        reg_dir = str(tmp_path / "reg")
+        reg = ModelRegistry(reg_dir)
+        assert reg.publish(art) == 1
+        assert reg.publish(quant1, activate=False) == 2
+        reg.set_route("q", 2)
+
+        procs = _spawn_fleet(reg_dir, n=2)
+        proxy = FleetProxy(("127.0.0.1", 0),
+                           [f"127.0.0.1:{p}" for _, p in procs],
+                           health_poll_s=0.2, retry_deadline_s=20.0)
+        pt = threading.Thread(target=proxy.serve_forever, daemon=True)
+        pt.start()
+        port = proxy.server_address[1]
+        try:
+            threads_d, stats_d = _closed_loop(port, rows, expected_default,
+                                              duration_s=6.0, n_threads=2)
+            threads_q, stats_q = _closed_loop(port, rows, expected_q,
+                                              duration_s=6.0, n_threads=2,
+                                              route="q")
+            time.sleep(1.5)
+            # an unrelated publish mid-traffic (registry churn the routes
+            # must shrug off), then a quantized hot swap on the named
+            # route only — the default route must be untouched
+            assert reg.publish(_retrain_artifact(art, 0.9),
+                               activate=False) == 3
+            vq = reg.publish(quant2, activate=False)
+            assert vq == 4
+            reg.set_route("q", vq)
+            for t in threads_d + threads_q:
+                t.join(timeout=60)
+            assert stats_d["errors"] == [], stats_d["errors"][:5]
+            assert stats_q["errors"] == [], stats_q["errors"][:5]
+            assert stats_d["n"] > 0 and stats_q["n"] > 0
+            assert stats_d["versions"] == {1}, "default route was disturbed"
+            assert 4 in stats_q["versions"], "route swap never hit traffic"
+            # both replicas converged to the swapped route version
+            for _, rport in procs:
+                st = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{rport}/stats", timeout=30).read())
+                assert st["routes"]["q"]["version"] == vq
+                assert st["routes"]["q"]["quantized"] is True
+                assert st["per_route"]["q"]["requests"] > 0
         finally:
             proxy.shutdown()
             proxy.server_close()

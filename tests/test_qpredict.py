@@ -1,0 +1,315 @@
+"""Quantized-serving tests: the int16 rank-quantized traversal
+(``ops/qpredict``), the quantized artifact flavor, and the
+``LIGHTGBM_TPU_QUANT_PREDICT`` pin.
+
+The accuracy contract under test: route decisions (leaf assignments)
+must agree EXACTLY with the f64 reference for every input — the rank
+encoding removes the bin-boundary caveat — and raw scores may drift only
+by the f16/bf16 leaf narrowing, within ``drift_bound``.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import compilewatch
+from lightgbm_tpu.ops import qpredict as qp
+from lightgbm_tpu.serve import (
+    BucketedQuantizedPredictor,
+    PackedPredictor,
+    PredictorArtifact,
+    SwappablePredictor,
+    pad_qtree_arrays,
+    tree_shape_bucket,
+)
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def _train(seed, n=500, f=10, rounds=10, leaves=15, objective="binary",
+           num_class=1):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    # plant exact zeros and NaN so the default-value remap is exercised
+    X[rng.rand(n, f) < 0.05] = 0.0
+    if objective == "binary":
+        y = (X[:, 0] + 0.5 * X[:, 1] - X[:, 2] ** 2 > -0.5).astype(np.float32)
+    elif objective == "multiclass":
+        y = (np.abs(X[:, 0]) + X[:, 1] > 0.7).astype(np.float32) + (
+            X[:, 2] > 0.5).astype(np.float32)
+    else:
+        y = (X[:, 0] + 0.3 * X[:, 1] ** 2).astype(np.float32)
+    params = {"objective": objective, "num_leaves": leaves, "verbose": -1}
+    if objective == "multiclass":
+        params["num_class"] = num_class
+    ds = lgb.Dataset(X, label=y, params={"min_data_in_leaf": 5})
+    bst = lgb.train(params, ds, num_boost_round=rounds, verbose_eval=False)
+    return bst, X, rng
+
+
+def _eval_rows(X, rng):
+    """Adversarial request rows: fresh draws + zeros + NaN + rows copied
+    from training data (which sit EXACTLY on split thresholds)."""
+    rows = np.concatenate([rng.randn(67, X.shape[1]), X[:40]], axis=0)
+    rows[3, 0] = 0.0
+    rows[5, 1] = np.nan
+    rows[7] = 0.0
+    return rows
+
+
+def _qpredict_scores(q, rows):
+    """(N,) single-class raw scores through the direct kernel."""
+    import jax.numpy as jnp
+
+    qb = qp.quantize_data(rows, q.qbin_edges, q.qbin_offsets,
+                          q.feature_flags)
+    args = [jnp.asarray(getattr(q, f)) for f in q.NODE_FIELDS]
+    return np.asarray(
+        qp.qpredict_raw(jnp.asarray(qb), *args, levels=q.levels), np.float64)
+
+
+def _qpredict_leaves(q, rows):
+    import jax.numpy as jnp
+
+    qb = qp.quantize_data(rows, q.qbin_edges, q.qbin_offsets,
+                          q.feature_flags)
+    args = [jnp.asarray(getattr(q, f)) for f in q.NODE_FIELDS[:-1]]
+    return np.asarray(
+        qp.qpredict_leaf(jnp.asarray(qb), *args, levels=q.levels))
+
+
+# ----------------------------------------------------------------------
+# encoding
+# ----------------------------------------------------------------------
+class TestEncoding:
+    def test_even_odd_rank_codes(self):
+        table = np.array([-1.5, 0.25, 3.0])
+        v = np.array([-2.0, -1.5, -1.0, 0.25, 1.0, 3.0, 4.0])
+        got = qp._encode(table, v)
+        #      below t0, ==t0, between, ==t1, between, ==t2, above
+        assert got.tolist() == [0, 1, 2, 3, 4, 5, 6]
+        # the node code for table[i] is 2i+1; v <= t  <=>  code <= 2i+1
+        for i, t in enumerate(table):
+            assert np.array_equal(got <= 2 * i + 1, v <= t)
+            assert np.array_equal(got == 2 * i + 1, v == t)
+
+    def test_empty_table(self):
+        assert qp._encode(np.array([]), np.array([1.0, -1.0])).tolist() \
+            == [0, 0]
+
+    def test_quantize_data_zero_and_nan_sentinel(self, ):
+        edges = np.array([0.5, 2.0])
+        off = np.array([0, 2], np.int32)
+        flags = np.zeros(1, np.int8)
+        rows = np.array([[1.0], [0.0], [np.nan], [1e-40], [3.0]])
+        got = qp.quantize_data(rows, edges, off, flags)
+        assert got.dtype == np.int16
+        assert got[1, 0] == qp.ZERO_CODE
+        assert got[2, 0] == qp.ZERO_CODE
+        assert got[3, 0] == qp.ZERO_CODE  # inside MISSING_VALUE_RANGE
+        assert got[0, 0] == 2 and got[4, 0] == 4
+
+
+# ----------------------------------------------------------------------
+# traversal accuracy: randomized A/B property test vs the exact path
+# ----------------------------------------------------------------------
+class TestQuantizedAccuracy:
+    @pytest.mark.parametrize("seed,objective,leaves,rounds", [
+        (0, "binary", 15, 10),
+        (1, "binary", 31, 20),
+        (2, "regression", 15, 12),
+        (3, "binary", 7, 5),
+    ])
+    def test_leaf_routes_exact_scores_within_bound(self, seed, objective,
+                                                   leaves, rounds):
+        bst, X, rng = _train(seed, objective=objective, leaves=leaves,
+                             rounds=rounds)
+        art = PredictorArtifact.from_booster(bst)
+        q = qp.quantize_tree_arrays(art.arrays,
+                                    num_features=art.num_features)
+        rows = _eval_rows(X, rng)
+        # route decisions agree EXACTLY with the f64 reference
+        ref_leaves = bst.predict(rows, pred_leaf=True)
+        if ref_leaves.ndim == 1:
+            ref_leaves = ref_leaves.reshape(-1, 1)
+        assert np.array_equal(_qpredict_leaves(q, rows).T, ref_leaves)
+        # raw scores drift only by the leaf narrowing, within the bound
+        ref = bst.predict(rows, raw_score=True)
+        bound = qp.drift_bound(art.arrays.leaf_value)
+        diff = np.abs(_qpredict_scores(q, rows) - ref).max()
+        assert diff <= bound, f"drift {diff} exceeds bound {bound}"
+
+    @pytest.mark.parametrize("leaf_dtype", ["float16", "bfloat16"])
+    def test_leaf_dtypes(self, leaf_dtype):
+        bst, X, rng = _train(5)
+        art = PredictorArtifact.from_booster(bst)
+        q = qp.quantize_tree_arrays(art.arrays, leaf_dtype=leaf_dtype,
+                                    num_features=art.num_features)
+        assert q.leaf_dtype == leaf_dtype
+        rows = _eval_rows(X, rng)
+        bound = qp.drift_bound(art.arrays.leaf_value, leaf_dtype=leaf_dtype)
+        diff = np.abs(_qpredict_scores(q, rows)
+                      - bst.predict(rows, raw_score=True)).max()
+        assert diff <= bound
+
+    def test_multiclass(self):
+        bst, X, rng = _train(6, objective="multiclass", num_class=3)
+        art = PredictorArtifact.from_booster(bst)
+        pq = PackedPredictor(art, quantized=True)
+        rows = _eval_rows(X, rng)
+        got = pq.predict(rows)
+        ref = bst.predict(rows)
+        assert got.shape == ref.shape
+        assert np.abs(got - ref).max() < 1e-2
+        # probabilities still normalize
+        assert np.allclose(got.sum(axis=1), 1.0, atol=1e-6)
+
+    def test_bucketed_predictor_matches_direct_traversal(self):
+        bst, X, rng = _train(7)
+        art = PredictorArtifact.from_booster(bst)
+        q = qp.quantize_tree_arrays(art.arrays,
+                                    num_features=art.num_features)
+        rows = _eval_rows(X, rng)
+        direct = _qpredict_scores(q, rows)
+        bq = BucketedQuantizedPredictor.from_qtree_arrays(q, 1)
+        assert np.allclose(bq.predict_raw_scores(rows), direct, atol=1e-6)
+
+
+# ----------------------------------------------------------------------
+# artifact flavor + env pin
+# ----------------------------------------------------------------------
+class TestQuantizedArtifact:
+    def test_roundtrip_and_versioning(self, tmp_path):
+        bst, X, rng = _train(8)
+        exact = PredictorArtifact.from_booster(bst)
+        quant = PredictorArtifact.from_booster(bst, quantized=True)
+        assert exact.flavor == "exact"
+        assert exact.meta["format_version"] == 1
+        assert quant.flavor == "quantized"
+        assert quant.meta["format_version"] == 2
+        assert quant.meta["leaf_dtype"] == "float16"
+        path = quant.save(str(tmp_path / "q"))
+        loaded = PredictorArtifact.load(path)
+        assert loaded.flavor == "quantized"
+        rows = _eval_rows(X, rng)
+        assert np.array_equal(PackedPredictor(quant).predict(rows),
+                              PackedPredictor(loaded).predict(rows))
+
+    def test_bfloat16_roundtrip(self, tmp_path):
+        bst, X, rng = _train(9)
+        quant = PredictorArtifact.from_booster(bst, quantized=True,
+                                               leaf_dtype="bfloat16")
+        assert quant.arrays.leaf_dtype == "bfloat16"
+        buf = io.BytesIO()
+        quant.save_to_bytes(buf)
+        loaded = PredictorArtifact.load_bytes(buf.getvalue())
+        assert loaded.arrays.leaf_dtype == "bfloat16"
+        rows = _eval_rows(X, rng)
+        assert np.array_equal(PackedPredictor(quant).predict(rows),
+                              PackedPredictor(loaded).predict(rows))
+
+    def test_quantize_from_loaded_exact_is_lossless(self, tmp_path):
+        """Triple-float reconstruction is exact, so quantizing a loaded
+        exact artifact equals quantizing straight off the booster."""
+        bst, X, rng = _train(10)
+        direct = PredictorArtifact.from_booster(bst, quantized=True)
+        path = PredictorArtifact.from_booster(bst).save(str(tmp_path / "e"))
+        via_disk = PredictorArtifact.load(path).quantize()
+        rows = _eval_rows(X, rng)
+        assert np.array_equal(PackedPredictor(direct).predict(rows),
+                              PackedPredictor(via_disk).predict(rows))
+
+    def test_artifact_bytes_reduced(self):
+        """The quantized flavor's serialized payload and device-resident
+        bytes must both be at least 2x smaller (uncompressed payload; the
+        traversal state drops from 11 wide planes to 7 narrow ones)."""
+        bst, _, _ = _train(11, rounds=30, leaves=31)
+        exact = PredictorArtifact.from_booster(bst)
+        quant = exact.quantize()
+        ex_payload = sum(a.nbytes for a in exact._payload().values())
+        q_payload = sum(a.nbytes for a in quant._payload().values())
+        assert q_payload * 2 <= ex_payload, (ex_payload, q_payload)
+        assert quant.device_bytes_estimate() * 2 \
+            <= exact.device_bytes_estimate()
+        ex_dev = PackedPredictor(exact, quantized=False).device_bytes
+        q_dev = PackedPredictor(quant).device_bytes
+        assert q_dev * 2 <= ex_dev, (ex_dev, q_dev)
+
+    def test_env_pin_off_forces_exact(self, monkeypatch):
+        bst, X, rng = _train(12)
+        art = PredictorArtifact.from_booster(bst)
+        rows = _eval_rows(X, rng)
+        ref = PackedPredictor(art).predict(rows)
+        monkeypatch.setenv("LIGHTGBM_TPU_QUANT_PREDICT", "0")
+        # quantized=True is overridden by the pin: bit-identical output
+        pinned = PackedPredictor(art, quantized=True)
+        assert not pinned.quantized
+        assert np.array_equal(pinned.predict(rows), ref)
+        # Booster.predict honors the pin end-to-end
+        assert np.array_equal(bst.predict(rows), ref)
+
+    def test_env_pin_on_routes_booster_predict(self, monkeypatch):
+        bst, X, rng = _train(13)
+        rows = _eval_rows(X, rng)
+        ref = bst.predict(rows, raw_score=True)
+        monkeypatch.setenv("LIGHTGBM_TPU_QUANT_PREDICT", "1")
+        got = bst.predict(rows, raw_score=True)
+        bound = qp.drift_bound(
+            PredictorArtifact.from_booster(bst).arrays.leaf_value)
+        assert np.abs(got - ref).max() <= bound
+        # leaf routes are unaffected by the pin (exact by construction)
+        assert np.array_equal(bst.predict(rows, pred_leaf=True),
+                              _unpinned_leaves(bst, rows, monkeypatch))
+
+    def test_quantized_artifact_with_pin_off_warns_and_serves(
+            self, monkeypatch):
+        quant = PredictorArtifact.from_booster(_train(14)[0], quantized=True)
+        monkeypatch.setenv("LIGHTGBM_TPU_QUANT_PREDICT", "0")
+        p = PackedPredictor(quant)  # no exact planes left: stays quantized
+        assert p.quantized
+
+    def test_oversized_model_refused(self):
+        bst, _, _ = _train(15)
+        art = PredictorArtifact.from_booster(bst)
+        with pytest.raises(LightGBMError, match="exact artifact"):
+            qp.quantize_tree_arrays(art.arrays, num_features=40000)
+
+
+def _unpinned_leaves(bst, rows, monkeypatch):
+    monkeypatch.delenv("LIGHTGBM_TPU_QUANT_PREDICT", raising=False)
+    out = bst.predict(rows, pred_leaf=True)
+    monkeypatch.setenv("LIGHTGBM_TPU_QUANT_PREDICT", "1")
+    return out
+
+
+# ----------------------------------------------------------------------
+# compile-cache integration: level padding + zero-compile swap
+# ----------------------------------------------------------------------
+class TestQuantizedCompileCache:
+    def test_pad_qtree_levels_power_of_two(self):
+        bst, _, _ = _train(16)
+        art = PredictorArtifact.from_booster(bst)
+        q = qp.quantize_tree_arrays(art.arrays,
+                                    num_features=art.num_features)
+        padded = pad_qtree_arrays(q)
+        assert padded.levels == tree_shape_bucket(q.levels)
+        assert padded.split_feature.shape[1] \
+            == tree_shape_bucket(q.split_feature.shape[1])
+        assert padded.leaf_value.shape[1] \
+            == tree_shape_bucket(q.leaf_value.shape[1])
+
+    def test_same_shape_quantized_swap_zero_new_compiles(self):
+        """The multi-model acceptance contract: retraining with the same
+        config and hot-swapping the QUANTIZED artifact must reuse every
+        XLA program — zero new compiles."""
+        bst, X, _ = _train(17)
+        bst2, _, _ = _train(18)  # same config, different data -> same shapes
+        a1 = PredictorArtifact.from_booster(bst, quantized=True)
+        a2 = PredictorArtifact.from_booster(bst2, quantized=True)
+        sw = SwappablePredictor(PackedPredictor(a1), version=1)
+        sw.warmup(64)
+        stats = sw.swap_to(a2, 2, warmup_max_rows=64)
+        assert stats["new_compiles"] == 0, stats
+        out, ver = sw.predict(X[:8])
+        assert ver == 2 and out.shape == (8,)
