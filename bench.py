@@ -70,11 +70,25 @@ def apply_regression_gate(out: dict, bench_dir: str = None, env=None) -> int:
     env = env if env is not None else os.environ
     if env.get("BENCH_GATE", "1") == "0":
         return 0
-    if out.get("backend_fallback"):
-        return 0
     if bench_dir is None:
         bench_dir = os.path.dirname(os.path.abspath(__file__)) or "."
     rc = 0
+    # comms payload-ratio leg FIRST (docs/PARALLEL.md): the bytes/iter
+    # numbers are pure protocol arithmetic — deterministic and
+    # device-INDEPENDENT — so voting's >=5x allreduce-payload cut over
+    # data-parallel gates outright, even on a backend_fallback capture
+    cm = out.get("comms") or {}
+    ratio_c = cm.get("voting_vs_data_payload_ratio")
+    if cm and not cm.get("error") and isinstance(ratio_c, (int, float)):
+        out["gate_comms"] = {
+            "min_voting_vs_data_payload_ratio": 5.0,
+            "voting_vs_data_payload_ratio": round(float(ratio_c), 2),
+        }
+        if float(ratio_c) < 5.0:
+            out["regression_comms_payload"] = True
+            rc = 1
+    if out.get("backend_fallback"):
+        return rc
     best, src = best_prior_sec_per_iter(bench_dir, out.get("metric"))
     if best is not None:
         threshold = best * 1.10
@@ -265,6 +279,49 @@ def apply_regression_gate(out: dict, bench_dir: str = None, env=None) -> int:
             if float(val_f) > thr_f:
                 out["regression_factory"] = True
                 rc = 1
+    # comms wall-clock legs (device-bound, so non-fallback captures
+    # only — the payload-ratio leg above already ran regardless): each
+    # learner's s/iter gates against priors at the same
+    # (rows, features, ranks) grid
+    if cm and not cm.get("error"):
+        key_c = (cm.get("rows"), cm.get("features"), cm.get("ranks"))
+        for mode_c in ("data", "feature", "voting"):
+            val_c = ((cm.get("per_learner") or {}).get(mode_c)
+                     or {}).get("s_per_iter")
+            if not (isinstance(val_c, (int, float)) and val_c > 0):
+                continue
+            best_c, src_c = None, None
+            for path in sorted(glob.glob(os.path.join(bench_dir,
+                                                      "BENCH_r*.json"))):
+                try:
+                    with open(path) as f:
+                        doc = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                parsed = doc.get("parsed") if isinstance(doc, dict) else None
+                if not isinstance(parsed, dict):
+                    parsed = doc if isinstance(doc, dict) else {}
+                if parsed.get("backend_fallback"):
+                    continue
+                pc = parsed.get("comms") or {}
+                if (pc.get("rows"), pc.get("features"),
+                        pc.get("ranks")) != key_c:
+                    continue
+                pv = ((pc.get("per_learner") or {}).get(mode_c)
+                      or {}).get("s_per_iter")
+                if isinstance(pv, (int, float)) and pv > 0 and (
+                        best_c is None or pv < best_c):
+                    best_c, src_c = float(pv), os.path.basename(path)
+            if best_c is not None:
+                thr_c = best_c * 1.10
+                out.setdefault("gate_comms_wall", {})[mode_c] = {
+                    "best_prior_s_per_iter": round(best_c, 4),
+                    "best_prior_source": src_c,
+                    "threshold_s_per_iter": round(thr_c, 4),
+                }
+                if float(val_c) > thr_c:
+                    out["regression_comms_wall"] = True
+                    rc = 1
     return rc
 
 
@@ -1001,6 +1058,116 @@ def _bench_kernel_ab():
     return section
 
 
+def _bench_comms():
+    """Comms-volume A/B of the three distributed tree learners
+    (docs/PARALLEL.md) on a synthetic WIDE matrix (>= 2000 features):
+    purpose-tagged bytes/iter and s/iter per learner over an in-process
+    2-rank LocalComm group (parallel/comm.py) — the same learner code
+    the KV transport drives, minus the network, so the byte ledger is
+    exact protocol arithmetic.  The voting-vs-data payload ratio is
+    deterministic and device-independent (it gates even on
+    backend_fallback captures); the s/iter numbers are device-bound.
+    BENCH_COMMS=0 skips; BENCH_COMMS_FEATURES / BENCH_COMMS_ROWS /
+    BENCH_COMMS_ITERS / BENCH_COMMS_TOPK resize."""
+    import threading
+
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.grow import GrowParams
+    from lightgbm_tpu.ops.split import FeatureMeta, SplitHyper
+    from lightgbm_tpu.parallel import HostParallelLearner, LocalGroup
+
+    F = int(os.environ.get("BENCH_COMMS_FEATURES", 2000))
+    n = int(os.environ.get("BENCH_COMMS_ROWS", 3000))
+    iters = int(os.environ.get("BENCH_COMMS_ITERS", 2))
+    top_k = int(os.environ.get("BENCH_COMMS_TOPK", 20))
+    B, R = 16, 2
+    try:
+        rng = np.random.RandomState(23)
+        bins = rng.randint(0, B, size=(n, F)).astype(np.uint8)
+        grad = (bins[:, :8].astype(np.float32)
+                @ rng.randn(8).astype(np.float32) / B
+                + 0.05 * rng.randn(n).astype(np.float32)
+                ).astype(np.float32)
+        hess = np.ones(n, np.float32)
+        meta = FeatureMeta(jnp.full((F,), B, jnp.int32),
+                           jnp.zeros((F,), jnp.int32),
+                           jnp.zeros((F,), bool))
+        hyper = SplitHyper(jnp.float32(0.0), jnp.float32(0.1),
+                           jnp.float32(20.0), jnp.float32(1e-3),
+                           jnp.float32(0.0))
+        fmask = jnp.ones((F,), jnp.float32)
+        # small row_block: the histogram one-hot tile is
+        # row_block x (F*B) f32 — the default 4096 rows would be 1 GB
+        # at F=2000
+        params = GrowParams(num_leaves=15, num_bins=B, row_block=256,
+                            top_k=top_k)
+        cut = n // 2
+
+        def run(mode):
+            sh = ([(bins, grad, hess)] * R if mode == "feature"
+                  else [(bins[:cut], grad[:cut], hess[:cut]),
+                        (bins[cut:], grad[cut:], hess[cut:])])
+            grp = LocalGroup(R)
+            ledgers = [None] * R
+            errs = []
+
+            def worker(r, comm, reps):
+                try:
+                    b, g, h = sh[r]
+                    ln = HostParallelLearner(mode, comm, params)
+                    for _ in range(reps):
+                        ln.grow(jnp.asarray(b), jnp.asarray(g),
+                                jnp.asarray(h),
+                                jnp.ones((b.shape[0],), jnp.float32),
+                                fmask, meta, hyper)
+                    ledgers[r] = dict(comm.ledger)
+                except BaseException as e:  # noqa: BLE001
+                    errs.append(e)
+
+            def sweep(reps):
+                ts = [threading.Thread(target=worker, args=(r, c, reps))
+                      for r, c in enumerate(grp.comms())]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                if errs:
+                    raise errs[0]
+
+            sweep(1)  # warmup: compile the mode's kernels off the clock
+            warm = dict(ledgers[0])
+            t0 = time.time()
+            sweep(iters)
+            wall = time.time() - t0
+            total = sum(ledgers[0].values()) - sum(warm.values())
+            return {
+                "bytes_per_iter": round(total / max(iters, 1), 1),
+                "s_per_iter": round(wall / max(iters, 1), 4),
+                "ledger_bytes_per_iter": {
+                    k: round((ledgers[0][k] - warm.get(k, 0))
+                             / max(iters, 1), 1)
+                    for k in sorted(ledgers[0])
+                },
+            }
+
+        per = {m: run(m) for m in ("data", "feature", "voting")}
+        d_b = per["data"]["bytes_per_iter"]
+        v_b = per["voting"]["bytes_per_iter"]
+        f_b = per["feature"]["bytes_per_iter"]
+        return {
+            "rows": n, "features": F, "ranks": R, "iters": iters,
+            "top_k": top_k,
+            "per_learner": per,
+            "voting_vs_data_payload_ratio":
+                round(d_b / v_b, 2) if v_b else None,
+            "feature_vs_data_payload_ratio":
+                round(d_b / f_b, 2) if f_b else None,
+        }
+    except Exception as e:  # pragma: no cover
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _auc(y, s):
     """AUC via the library's own metric (one implementation to trust)."""
     from lightgbm_tpu.config import Config
@@ -1371,6 +1538,14 @@ def main():
     # tree-count-matched cold retrain, canary-window plumbing overhead
     if os.environ.get("BENCH_FACTORY", "0" if backend_fallback else "1") != "0":
         out["factory"] = _bench_factory(X, y)
+
+    # comms section (docs/PARALLEL.md): bytes/iter + s/iter of the
+    # data/feature/voting distributed learners on a >=2000-feature
+    # synthetic.  Runs even on backend_fallback: the payload numbers are
+    # protocol arithmetic, and the voting-vs-data ratio is the
+    # device-independent leg of the regression gate.
+    if os.environ.get("BENCH_COMMS", "1") != "0":
+        out["comms"] = _bench_comms()
 
     # kernel A/B section (docs/PERFORMANCE.md): the PR-6 kernel wins
     # measured head-to-head WITH parity checks — on a dead tunnel this is

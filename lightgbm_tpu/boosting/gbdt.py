@@ -169,7 +169,20 @@ class GBDT:
 
             from ..parallel import ShardedLearner, make_mesh
 
-            if len(_jax.devices()) < 2:
+            nproc = _jax.process_count()
+            if nproc > 1 and learner_type in ("feature", "voting"):
+                # column-sharded / PV-Tree learners have no fused
+                # multi-process formulation: the host drives the
+                # leaf-wise loop over the hardened byte collectives
+                from ..parallel.comm import NetComm
+                from ..parallel.hostlearner import HostParallelLearner
+
+                self.learner = HostParallelLearner(
+                    learner_type, NetComm(), self.grow_params)
+                Log.info(
+                    "Using host-driven %s-parallel learner over %d "
+                    "processes", learner_type, nproc)
+            elif len(_jax.devices()) < 2:
                 Log.warning(
                     "tree_learner=%s requested but only one device is "
                     "visible; falling back to serial", learner_type,
@@ -197,9 +210,24 @@ class GBDT:
                             self.ptrainer.d,
                         )
                 if self.ptrainer is None:
-                    self.learner = ShardedLearner(
-                        learner_type, make_mesh(), self.grow_params
-                    )
+                    if nproc > 1 and _jax.default_backend() == "cpu":
+                        # XLA:CPU rejects multi-process computations;
+                        # data-parallel runs host-driven over the KV
+                        # collectives (same transport rule as collect.py)
+                        from ..parallel.comm import NetComm
+                        from ..parallel.hostlearner import (
+                            HostParallelLearner,
+                        )
+
+                        self.learner = HostParallelLearner(
+                            "data", NetComm(), self.grow_params)
+                        Log.info(
+                            "Using host-driven data-parallel learner "
+                            "over %d processes", nproc)
+                    else:
+                        self.learner = ShardedLearner(
+                            learner_type, make_mesh(), self.grow_params
+                        )
         elif learner_type != "serial":
             Log.fatal("Unknown tree learner type %s", config.tree_learner)
 
@@ -370,6 +398,12 @@ class GBDT:
 
         self._boost_from_average()
 
+        # comms-volume accounting: the host-driven parallel learners keep
+        # an always-on purpose->bytes ledger; snapshot it around the
+        # iteration so irec carries this iteration's bytes sent
+        comm = getattr(self.learner, "comm", None)
+        bytes_before = comm.ledger_total() if comm is not None else 0
+
         with tracer.iteration(self.iter) as irec:
             with timetag.phase("boosting"):
                 if gradients is None or hessians is None:
@@ -435,6 +469,8 @@ class GBDT:
                 irec["trees"] = self.num_tree_per_iteration
                 if self.is_bagging:
                     irec["bagged_rows"] = int(jnp.sum(self.select))
+                if comm is not None:
+                    irec["net_bytes"] = comm.ledger_total() - bytes_before
 
         if not should_continue:
             Log.warning(

@@ -1513,8 +1513,10 @@ def eligible(config, train_set, objective, num_tree_per_iteration: int) -> bool:
         # GBDT here would be an algorithm regression)
         if getattr(config, "boosting", "gbdt") == "goss":
             return False
-    # serial -> PartitionedTrainer; data -> ShardedPartitionedTrainer
-    # (feature/voting keep the mask grower's collective formulations)
+    # serial -> PartitionedTrainer; data -> ShardedPartitionedTrainer.
+    # feature/voting keep the mask grower's collective formulations on a
+    # device mesh, or the host-driven learners (parallel/hostlearner.py)
+    # across processes — their per-node exchanges don't fuse.
     if config.tree_learner not in ("serial", "data"):
         return False
     if np.asarray(train_set.binned).dtype != np.uint8:

@@ -68,6 +68,8 @@ PARAM_ALIASES: Dict[str, str] = {
     "subsample_freq": "bagging_freq",
     "shrinkage_rate": "learning_rate",
     "tree": "tree_learner",
+    "tree_learner_type": "tree_learner",
+    "tree_type": "tree_learner",
     "num_machine": "num_machines",
     "local_port": "local_listen_port",
     "two_round_loading": "use_two_round_loading",
@@ -312,12 +314,27 @@ class Config:
         """CheckParamConflict (config.cpp): parallel learners imply
         is_parallel; bagging requires fraction<1 and freq>0; etc."""
         learner = self.tree_learner.lower()
+        if learner not in ("serial", "data", "feature", "voting"):
+            Log.fatal(
+                "tree_learner must be one of serial/data/feature/voting, "
+                "got %s", self.tree_learner)
         if learner in ("feature", "data", "voting") and self.num_machines > 1:
             self.is_parallel = True
         else:
             self.is_parallel = False
         if learner == "data" or learner == "voting":
             self.is_parallel_find_bin = self.is_parallel
+        if self.top_k < 1:
+            Log.fatal("top_k must be >= 1 for voting-parallel, got %d",
+                      self.top_k)
+        if (learner == "voting"
+                and str(self.out_of_core).lower() in ("true", "1", "on",
+                                                      "yes")):
+            Log.fatal(
+                "tree_learner=voting cannot run with out_of_core=true: "
+                "the voting learner's per-node elected-histogram exchange "
+                "needs the full resident bin matrix. Set out_of_core=false "
+                "(or auto) or switch to tree_learner=data.")
         if self.num_leaves < 2:
             Log.fatal("num_leaves must be >= 2, got %d", self.num_leaves)
         if not (0.0 < self.feature_fraction <= 1.0):

@@ -298,7 +298,8 @@ def merge_summary(by_rank: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
             wall = float(r.get("wall_s", 0.0))
             wait = min(_iter_wait_s(ph), wall)
             per_it[it] = {"wall_s": wall, "wait_s": wait,
-                          "compute_s": wall - wait}
+                          "compute_s": wall - wait,
+                          "net_bytes": float(r.get("net_bytes", 0.0))}
             for name, dur in ph.items():
                 phases.setdefault(name, {})
                 phases[name][rank] = phases[name].get(rank, 0.0) + float(dur)
@@ -321,6 +322,7 @@ def merge_summary(by_rank: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
     for rank in ranks:
         wall = sum(iters[rank][it]["wall_s"] for it in common)
         wait = sum(iters[rank][it]["wait_s"] for it in common)
+        nbytes = sum(iters[rank][it]["net_bytes"] for it in common)
         per_rank[rank] = {
             "iterations": len(iters[rank]),
             "aligned_iterations": len(common),
@@ -328,6 +330,9 @@ def merge_summary(by_rank: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
             "compute_s": round(wall - wait, 6),
             "barrier_wait_s": round(wait, 6),
             "net_wait_total_s": round(_rank_net_wait_s(by_rank[rank]), 6),
+            "net_bytes": int(nbytes),
+            "bytes_per_iter": round(nbytes / len(common), 1) if common
+            else 0.0,
         }
     out: Dict[str, Any] = {
         "ranks": ranks,
@@ -372,12 +377,13 @@ def render_merge(m: Dict[str, Any]) -> str:
     ranks = m["ranks"]
     lines.append("")
     lines.append(f"{'rank':<8}{'iters':>7}{'wall_s':>10}{'compute_s':>11}"
-                 f"{'barrier_wait_s':>16}")
+                 f"{'barrier_wait_s':>16}{'bytes/iter':>12}")
     for r in ranks:
         pr = m["per_rank"][r]
         lines.append(f"{r:<8}{pr['aligned_iterations']:>7}"
                      f"{pr['wall_s']:>10.3f}{pr['compute_s']:>11.3f}"
-                     f"{pr['barrier_wait_s']:>16.3f}")
+                     f"{pr['barrier_wait_s']:>16.3f}"
+                     f"{pr.get('bytes_per_iter', 0.0):>12.0f}")
     st = m.get("straggler")
     if st:
         share = st["slowest_rank_share"]

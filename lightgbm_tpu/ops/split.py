@@ -273,6 +273,43 @@ def finalize_split(gain_f, thr_f, dbz_f, left_f, sum_g, sum_h, num_data,
     )
 
 
+def slice_features(meta: FeatureMeta, lo: int, hi: int) -> FeatureMeta:
+    """Metadata for the contiguous column block ``[lo, hi)`` — the unit
+    the feature-parallel learner shards over."""
+    return FeatureMeta(
+        meta.num_bins[lo:hi], meta.default_bin[lo:hi],
+        meta.is_categorical[lo:hi]
+    )
+
+
+def best_split_feature_block(
+    hist: jnp.ndarray,
+    lo: jnp.ndarray,
+    sum_g: jnp.ndarray,
+    sum_h: jnp.ndarray,
+    num_data: jnp.ndarray,
+    meta_block: FeatureMeta,
+    hyper: SplitHyper,
+    feature_mask_block: jnp.ndarray,
+    use_missing: bool = True,
+) -> SplitResult:
+    """Best split over a contiguous column block starting at global
+    feature index ``lo``; ``hist``/``meta_block``/``feature_mask_block``
+    cover only the block's columns and the returned ``feature`` is
+    GLOBAL.  The per-feature scan is elementwise in F, so a block's
+    result equals the corresponding slice of the full-matrix scan bit
+    for bit — the property that lets feature-parallel ranks search only
+    their own columns yet reproduce the serial model exactly."""
+    gain_f, thr_f, dbz_f, left_f = best_split_per_feature(
+        hist, sum_g, sum_h, num_data, meta_block, hyper,
+        feature_mask_block, use_missing
+    )
+    res = finalize_split(
+        gain_f, thr_f, dbz_f, left_f, sum_g, sum_h, num_data, hyper
+    )
+    return res._replace(feature=res.feature + jnp.int32(lo))
+
+
 def best_split_all_features(
     hist: jnp.ndarray,
     sum_g: jnp.ndarray,

@@ -5,11 +5,17 @@ topology code is deleted outright; psum/all_gather/reduce_scatter already
 implement it in hardware).
 """
 
+from .comm import LocalComm, LocalGroup, NetComm
+from .hostlearner import HostParallelLearner
 from .learner import ShardedLearner, make_mesh
 from .net import CollectiveTimeoutError, NetError, PeerFailureError
 
 __all__ = [
     "ShardedLearner",
+    "HostParallelLearner",
+    "NetComm",
+    "LocalComm",
+    "LocalGroup",
     "make_mesh",
     "NetError",
     "PeerFailureError",

@@ -77,10 +77,13 @@ def _array_allgather(blob: bytes) -> List[bytes]:
     return out
 
 
-def allgather_bytes(blob: bytes) -> List[bytes]:
+def allgather_bytes(blob: bytes, purpose: str = "misc") -> List[bytes]:
     """One blob per process -> every process's blob, in process order.
     Bounded: raises ``net.PeerFailureError`` / ``CollectiveTimeoutError``
-    instead of hanging on a dead or wedged peer."""
+    instead of hanging on a dead or wedged peer.  ``purpose`` tags the
+    sent bytes in the comms-volume ledger (``net.bytes{purpose=...}``)
+    so per-learner payload profiles (hist vs best_split vs vote/elect)
+    fall out of the trace stream."""
     import jax
 
     if jax.process_count() == 1:
@@ -88,7 +91,10 @@ def allgather_bytes(blob: bytes) -> List[bytes]:
     net.fault_point("collective")
     net.ensure_heartbeat()
     transport = "kv" if jax.default_backend() == "cpu" else "array"
-    with tracer.span("net.allgather", transport=transport, bytes=len(blob)):
+    tracer.counter("net.bytes", float(len(blob)), purpose=purpose,
+                   transport=transport)
+    with tracer.span("net.allgather", transport=transport, bytes=len(blob),
+                     purpose=purpose):
         if transport == "kv":
             # XLA:CPU has no multi-process computations; use the KV store
             return _kv_allgather(blob)

@@ -1,0 +1,449 @@
+"""Host-driven distributed tree learners for wide data.
+
+``ops/grow.py`` folds the reference's three parallel modes into ONE
+fused XLA program per shard — ideal when every rank participates in a
+single multi-process computation (TPU meshes).  On backends where
+multi-process programs don't exist (XLA:CPU) the only cross-rank
+channel is the hardened byte-blob allgather (``parallel/net.py``), so
+this module re-expresses the same leaf-wise loop with the *host*
+driving control flow and tiny jitted kernels doing every piece of f32
+arithmetic:
+
+- ``mode="data"``     — rows sharded; each split allgathers the full
+  local (F, B, 3) histogram and merges it in rank order
+  (DataParallelTreeLearner; payload O(F*B) per node).
+- ``mode="feature"``  — columns sharded; each rank builds histograms
+  and finds best splits only for its own features, allreduces a 28-byte
+  best-split record, and the split owner broadcasts the partition
+  bitmap (FeatureParallelTreeLearner; payload O(1) per node).
+- ``mode="voting"``   — PV-Tree: each rank votes its local top-k
+  features by gain, a global election keeps the top-2k, and only the
+  elected columns' histograms are exchanged (payload O(2k*B) per node;
+  with 2k >= F the elected set covers every feature and the result is
+  bit-identical to ``data``).
+
+Bit-parity contract (pinned by tests/test_wide_learners.py):
+
+- feature mode reproduces the serial ``grow_tree`` model BITWISE —
+  per-feature split search is elementwise in F, and a histogram built
+  over a column slice equals the slice of the full histogram, so
+  sharding columns changes no arithmetic;
+- voting with 2k >= F reproduces data mode BITWISE — the elected-column
+  scatter covers every column, so the rank-order merge performs the
+  identical sequence of IEEE f32 adds.
+
+Every f32 value is produced by a jitted kernel mirroring grow.py's ops
+or by IEEE numpy scalar arithmetic; the host only does control flow
+(argmax = first-max, comparisons, integer bookkeeping), which is
+exact.  All ranks take identical decisions from identical gathered
+bytes, so collectives stay in lockstep program order (the KV GC
+invariant).
+
+Purpose tags on every exchange (``net.bytes{purpose=...}``):
+``hist`` histogram payloads, ``best_split`` split records / partition
+bitmaps / node counts, ``vote`` ballots, ``elect`` election results.
+"""
+
+from __future__ import annotations
+
+import functools
+import struct
+from typing import Dict, List
+
+import jax
+import numpy as np
+
+from ..obs import tracer
+from ..ops.grow import GrowParams, GrowResult
+from ..ops.histogram import build_histogram
+from ..ops.split import (
+    NEG_INF,
+    best_split_feature_block,
+    best_split_per_feature,
+    leaf_output,
+    slice_features,
+)
+from .comm import Comm
+
+# 28-byte best-split record: gain, feature, threshold_bin,
+# default_bin_for_zero, left (sum_g, sum_h, cnt) — the SplitInfo wire
+# format of FeatureParallelTreeLearner's Allreduce, minus the redundant
+# right-side fields (right = leaf totals - left, recomputed exactly)
+_REC = struct.Struct("<fiiifff")
+_CNT = struct.Struct("<ii")
+_SUMS = struct.Struct("<fff")
+
+
+# ---------------------------------------------------------------------
+# jitted kernels: every op mirrors the corresponding line of
+# ops/grow.py so standalone execution reproduces the fused program's
+# f32 arithmetic bit for bit
+# ---------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "row_block"))
+def _hist_leaf(bins, grad, hess, select, leaf_id, target, num_bins,
+               row_block):
+    sel = select * (leaf_id == target).astype(select.dtype)
+    return build_histogram(bins, grad, hess, sel, num_bins, row_block)
+
+
+@jax.jit
+def _root_sums(grad, hess, select):
+    import jax.numpy as jnp
+
+    return (jnp.sum(grad * select), jnp.sum(hess * select),
+            jnp.sum(select))
+
+
+@functools.partial(jax.jit, static_argnames=("use_missing",))
+def _best_split(hist, lo, sg, sh, sc, meta, hyper, fmask, use_missing):
+    return best_split_feature_block(hist, lo, sg, sh, sc, meta, hyper,
+                                    fmask, use_missing)
+
+
+@functools.partial(jax.jit, static_argnames=("use_missing",))
+def _local_gains(hist, sg, sh, sc, meta, hyper, fmask, use_missing):
+    gain_f, _, _, _ = best_split_per_feature(
+        hist, sg, sh, sc, meta, hyper, fmask, use_missing
+    )
+    return gain_f
+
+
+@jax.jit
+def _local_leaf_tot(hist):
+    import jax.numpy as jnp
+
+    return jnp.sum(hist[0], axis=0)  # (3,): identical for every feature
+
+
+@jax.jit
+def _leaf_out(g, h, l1, l2):
+    return leaf_output(g, h, l1, l2)
+
+
+@jax.jit
+def _goes_left(bins, feat, thr, dbz, zero_bin, is_cat):
+    import jax.numpy as jnp
+
+    col = jnp.take(bins, feat, axis=1).astype(jnp.int32)
+    fval = jnp.where(col == zero_bin, dbz, col)
+    return jnp.where(is_cat, fval == thr, fval <= thr)
+
+
+@jax.jit
+def _apply_partition(leaf_id, goes_left, bl, right_leaf):
+    import jax.numpy as jnp
+
+    in_leaf = leaf_id == bl
+    new_id = jnp.where(in_leaf & ~goes_left, right_leaf, leaf_id)
+    n_left = jnp.sum((in_leaf & goes_left).astype(jnp.int32))
+    return new_id, n_left
+
+
+class HostParallelLearner:
+    """Leaf-wise grower driven from the host over a :class:`Comm`.
+
+    Presents the same ``grow(...) -> GrowResult`` surface as
+    ``ShardedLearner`` so ``boosting/gbdt.py`` treats it as a drop-in
+    learner; inputs are this rank's shard (rows for data/voting, the
+    full replicated matrix for feature mode)."""
+
+    def __init__(self, mode: str, comm: Comm, params: GrowParams):
+        if mode not in ("data", "feature", "voting"):
+            raise ValueError(f"unknown host learner mode {mode!r}")
+        self.mode = mode
+        self.comm = comm
+        self.params = params
+
+    # -- helpers ------------------------------------------------------
+
+    def _feature_block(self, f: int):
+        """Contiguous column block [lo, hi) owned by this rank (same
+        blocking as ShardedLearner's per-shard feature mask)."""
+        per = -(-f // self.comm.nproc)
+        lo = min(f, self.comm.rank * per)
+        return per, lo, min(f, lo + per)
+
+    def _merge_f32(self, blobs: List[bytes], shape) -> np.ndarray:
+        """Rank-order sequential IEEE f32 adds — the determinism anchor
+        for the data <-> voting bit-parity contract."""
+        parts = [np.frombuffer(b, np.float32).reshape(shape) for b in blobs]
+        tot = parts[0].copy()
+        for p in parts[1:]:
+            tot = tot + p
+        return tot
+
+    # -- per-node best split, one exchange pattern per mode -----------
+
+    def _find_best(self, jnp, hist, sums, depth_ok, meta, hyper,
+                   feature_mask, f, lo):
+        """Returns (gain, feat, thr, dbz, left(3,)) as numpy scalars,
+        identical on every rank."""
+        p = self.params
+        sg, sh, sc = (np.float32(sums[0]), np.float32(sums[1]),
+                      np.float32(sums[2]))
+        if self.mode == "feature":
+            if hist is not None:
+                res = _best_split(hist, np.int32(lo), jnp.float32(sg),
+                                  jnp.float32(sh), jnp.float32(sc), meta,
+                                  hyper, feature_mask, p.use_missing)
+                rec = _REC.pack(float(res.gain), int(res.feature),
+                                int(res.threshold_bin),
+                                int(res.default_bin_for_zero),
+                                float(res.left_sum_g),
+                                float(res.left_sum_h),
+                                float(res.left_cnt))
+            else:  # more ranks than column blocks: vacuous candidate
+                rec = _REC.pack(NEG_INF, 0, 0, 0, 0.0, 0.0, 0.0)
+            recs = [_REC.unpack(b)
+                    for b in self.comm.allgather(rec, "best_split")]
+            gains = np.array([r[0] for r in recs], np.float32)
+            # first-max: ties resolve to the lowest rank = lowest global
+            # feature index under contiguous column blocks, matching the
+            # serial argmax tie-break
+            w = recs[int(np.argmax(gains))]
+            gain, feat, thr, dbz = w[0], w[1], w[2], w[3]
+            left = np.array(w[4:7], np.float32)
+        else:
+            if self.mode == "voting":
+                ghist, vmask = self._vote_and_merge(jnp, hist, meta, hyper,
+                                                    feature_mask, f)
+                fmask = feature_mask * jnp.asarray(vmask)
+            else:
+                blobs = self.comm.allgather(
+                    np.asarray(hist, np.float32).tobytes(), "hist")
+                ghist = self._merge_f32(blobs, (f, p.num_bins, 3))
+                fmask = feature_mask
+            res = _best_split(jnp.asarray(ghist), np.int32(0),
+                              jnp.float32(sg), jnp.float32(sh),
+                              jnp.float32(sc), meta, hyper, fmask,
+                              p.use_missing)
+            gain = float(res.gain)
+            feat, thr = int(res.feature), int(res.threshold_bin)
+            dbz = int(res.default_bin_for_zero)
+            left = np.array([float(res.left_sum_g), float(res.left_sum_h),
+                             float(res.left_cnt)], np.float32)
+        if not depth_ok:
+            gain = NEG_INF
+        return np.float32(gain), feat, thr, dbz, left
+
+    def _vote_and_merge(self, jnp, hist, meta, hyper, feature_mask, f):
+        """PV-Tree exchange: ballot -> election -> elected-column merge.
+        Returns (global (F, B, 3) hist with non-elected columns zero,
+        elected 0/1 mask)."""
+        p = self.params
+        nproc = self.comm.nproc
+        k = max(min(p.top_k, f), 1)
+        k2 = min(2 * k, f)
+        # local proposals under /nproc-relaxed constraints
+        # (voting_parallel_tree_learner.cpp:54-56)
+        lt = _local_leaf_tot(hist)
+        local_hyper = hyper._replace(
+            min_data_in_leaf=hyper.min_data_in_leaf / nproc,
+            min_sum_hessian_in_leaf=hyper.min_sum_hessian_in_leaf / nproc,
+        )
+        lg_f = np.asarray(_local_gains(hist, lt[0], lt[1], lt[2], meta,
+                                       local_hyper, feature_mask,
+                                       p.use_missing))
+        ballot = np.argsort(-lg_f, kind="stable")[:k].astype(np.int32)
+        blobs = self.comm.allgather(ballot.tobytes(), "vote")
+        votes = np.zeros((f,), np.float32)
+        for b in blobs:
+            votes[np.frombuffer(b, np.int32)] += 1.0
+        # stable sort: vote ties resolve toward the lower feature index
+        elected = np.sort(np.argsort(-votes, kind="stable")[:k2])
+        elected = elected.astype(np.int32)
+        echo = self.comm.allgather(elected.tobytes(), "elect")
+        if any(e != echo[0] for e in echo):  # pragma: no cover
+            raise RuntimeError(
+                "voting-parallel election disagreed across ranks — "
+                "non-deterministic local gains?")
+        sub = np.ascontiguousarray(np.asarray(hist, np.float32)[elected])
+        parts = self.comm.allgather(sub.tobytes(), "hist")
+        merged_sub = self._merge_f32(parts, (k2, p.num_bins, 3))
+        ghist = np.zeros((f, p.num_bins, 3), np.float32)
+        ghist[elected] = merged_sub
+        vmask = np.zeros((f,), np.float32)
+        vmask[elected] = 1.0
+        return ghist, vmask
+
+    # -- the leaf-wise loop -------------------------------------------
+
+    def grow(self, bins, grad, hess, select, feature_mask, meta, hyper):
+        with tracer.span("learner.grow", mode=self.mode,
+                         nproc=self.comm.nproc):
+            return self._grow(bins, grad, hess, select, feature_mask,
+                              meta, hyper)
+
+    def _grow(self, bins, grad, hess, select, feature_mask, meta, hyper):
+        import jax.numpy as jnp
+
+        p = self.params
+        n, f = bins.shape
+        L, B = p.num_leaves, p.num_bins
+        rowed = self.mode in ("data", "voting")  # row-sharded modes
+
+        if self.mode == "feature":
+            per, lo, hi = self._feature_block(f)
+            hbins = bins[:, lo:hi] if hi > lo else None
+            hmeta = slice_features(meta, lo, hi)
+            hmask = feature_mask[lo:hi]
+        else:
+            per, lo, hi = f, 0, f
+            hbins, hmeta, hmask = bins, meta, feature_mask
+
+        def node_hist(leaf_id, target):
+            if hbins is None:
+                return None
+            return _hist_leaf(hbins, grad, hess, select, leaf_id,
+                              np.int32(target), B, p.row_block)
+
+        # ---- root totals (LeafSplits::Init)
+        tg, th, tc = _root_sums(grad, hess, select)
+        if rowed:
+            blobs = self.comm.allgather(
+                _SUMS.pack(float(tg), float(th), float(tc)), "best_split")
+            vals = [np.array(_SUMS.unpack(b), np.float32) for b in blobs]
+            tot = vals[0].copy()
+            for v in vals[1:]:
+                tot = tot + v
+            tg, th, tc = tot[0], tot[1], tot[2]
+        else:
+            tg, th, tc = np.float32(tg), np.float32(th), np.float32(tc)
+
+        leaf_id = jnp.zeros((n,), jnp.int32)
+        root_hist = node_hist(leaf_id, 0)
+
+        # host-side _State mirror (numpy; device arrays only in pool)
+        bs_gain = np.full((L,), NEG_INF, np.float32)
+        bs_feat = np.zeros((L,), np.int32)
+        bs_thr = np.zeros((L,), np.int32)
+        bs_dbz = np.zeros((L,), np.int32)
+        bs_left = np.zeros((L, 3), np.float32)
+        leaf_sum = np.zeros((L, 3), np.float32)
+        leaf_value = np.zeros((L,), np.float32)
+        leaf_cnt = np.zeros((L,), np.float32)
+        leaf_depth = np.zeros((L,), np.int32)
+        leaf_rows = np.zeros((L,), np.int32)  # LOCAL rows
+        zri = np.zeros((L - 1,), np.int32)
+        zr = np.zeros((L - 1,), np.float32)
+        rec_leaf, rec_feat = zri.copy(), zri.copy()
+        rec_thr, rec_dbz = zri.copy(), zri.copy()
+        rec_gain, rec_lval, rec_rval = zr.copy(), zr.copy(), zr.copy()
+        rec_lcnt, rec_rcnt, rec_iv = zr.copy(), zr.copy(), zr.copy()
+
+        leaf_sum[0] = (tg, th, tc)
+        leaf_cnt[0] = tc
+        leaf_rows[0] = n
+        pool: Dict[int, object] = {0: root_hist}
+
+        def store(leafi, res):
+            bs_gain[leafi], bs_feat[leafi] = res[0], res[1]
+            bs_thr[leafi], bs_dbz[leafi] = res[2], res[3]
+            bs_left[leafi] = res[4]
+
+        find = functools.partial(self._find_best, jnp, meta=hmeta,
+                                 hyper=hyper, feature_mask=hmask, f=f,
+                                 lo=lo)
+        store(0, find(root_hist, leaf_sum[0], True))
+
+        num_splits = 0
+        l1, l2 = hyper.lambda_l1, hyper.lambda_l2
+        while num_splits < L - 1:
+            bl = int(np.argmax(bs_gain))  # first-max, like jnp.argmax
+            if not (bs_gain[bl] > 0.0):
+                break  # no further splits with positive gain
+            s = num_splits
+            right_leaf = s + 1
+            feat, thr, dbz = (int(bs_feat[bl]), int(bs_thr[bl]),
+                              int(bs_dbz[bl]))
+            left = bs_left[bl].copy()
+            right = leaf_sum[bl] - left  # IEEE f32, mirrors grow.py
+            lval = np.float32(_leaf_out(jnp.float32(left[0]),
+                                        jnp.float32(left[1]), l1, l2))
+            rval = np.float32(_leaf_out(jnp.float32(right[0]),
+                                        jnp.float32(right[1]), l1, l2))
+
+            # ---- partition (DataPartition::Split)
+            if self.mode == "feature":
+                owner = feat // per
+                if owner == self.comm.rank:
+                    mask = np.asarray(_goes_left(
+                        bins, np.int32(feat), np.int32(thr), np.int32(dbz),
+                        meta.default_bin[feat], meta.is_categorical[feat]))
+                    blob = np.packbits(mask).tobytes()
+                else:
+                    blob = b""
+                blobs = self.comm.allgather(blob, "best_split")
+                mask = np.unpackbits(
+                    np.frombuffer(blobs[owner], np.uint8), count=n
+                ).astype(bool)
+                leaf_id, n_left = _apply_partition(
+                    leaf_id, jnp.asarray(mask), np.int32(bl),
+                    np.int32(right_leaf))
+            else:
+                gl = _goes_left(bins, np.int32(feat), np.int32(thr),
+                                np.int32(dbz), meta.default_bin[feat],
+                                meta.is_categorical[feat])
+                leaf_id, n_left = _apply_partition(
+                    leaf_id, gl, np.int32(bl), np.int32(right_leaf))
+            n_left = int(n_left)
+            n_right = int(leaf_rows[bl]) - n_left
+
+            # ---- smaller child by GLOBAL row count (grow.py:394-404)
+            if rowed:
+                blobs = self.comm.allgather(_CNT.pack(n_left, n_right),
+                                            "best_split")
+                cnts = [_CNT.unpack(b) for b in blobs]
+                g_left = sum(c[0] for c in cnts)
+                g_right = sum(c[1] for c in cnts)
+            else:
+                g_left, g_right = n_left, n_right
+            is_left_smaller = g_left < g_right
+            smaller_id = bl if is_left_smaller else right_leaf
+            smaller = node_hist(leaf_id, smaller_id)
+            if smaller is not None:
+                larger = pool[bl] - smaller  # the subtraction trick
+            else:
+                larger = None
+            left_hist = smaller if is_left_smaller else larger
+            right_hist = larger if is_left_smaller else smaller
+            pool[bl], pool[right_leaf] = left_hist, right_hist
+
+            # ---- children best splits
+            child_depth = int(leaf_depth[bl]) + 1
+            depth_ok = p.max_depth <= 0 or child_depth < p.max_depth
+            lres = find(left_hist, left, depth_ok)
+            rres = find(right_hist, right, depth_ok)
+
+            rec_leaf[s], rec_feat[s] = bl, feat
+            rec_thr[s], rec_dbz[s] = thr, dbz
+            rec_gain[s] = bs_gain[bl]
+            rec_lval[s], rec_rval[s] = lval, rval
+            rec_lcnt[s], rec_rcnt[s] = left[2], right[2]
+            rec_iv[s] = leaf_value[bl]
+            leaf_sum[bl], leaf_sum[right_leaf] = left, right
+            leaf_value[bl], leaf_value[right_leaf] = lval, rval
+            leaf_cnt[bl], leaf_cnt[right_leaf] = left[2], right[2]
+            leaf_depth[bl] = leaf_depth[right_leaf] = child_depth
+            leaf_rows[bl], leaf_rows[right_leaf] = n_left, n_right
+            store(bl, lres)
+            store(right_leaf, rres)
+            num_splits += 1
+
+        return GrowResult(
+            num_splits=np.int32(num_splits),
+            leaf_id=leaf_id,
+            leaf_value=leaf_value,
+            leaf_cnt=leaf_cnt,
+            rec_leaf=rec_leaf,
+            rec_feat=rec_feat,
+            rec_thr=rec_thr,
+            rec_dbz=rec_dbz,
+            rec_gain=rec_gain,
+            rec_lval=rec_lval,
+            rec_rval=rec_rval,
+            rec_lcnt=rec_lcnt,
+            rec_rcnt=rec_rcnt,
+            rec_internal_value=rec_iv,
+        )

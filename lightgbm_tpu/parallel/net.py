@@ -43,9 +43,11 @@ from __future__ import annotations
 import dataclasses
 import os
 import signal
+import struct
 import sys
 import threading
 import time
+import zlib
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..obs import tracer
@@ -53,6 +55,7 @@ from ..utils.log import Log
 
 _HB_DIR = "ltpu_hb/"
 _COLLECT_DIR = "ltpu_collect/"
+_CHUNK_DIR = "ltpu_chunk/"
 
 
 def _flight_dump(reason: str, error: Optional[BaseException] = None,
@@ -198,6 +201,7 @@ def _reset_for_tests() -> None:
     with _fault_lock:
         _fault_specs = None
         _fault_calls = 0
+    _chunks_written.clear()
 
 
 # ----------------------------------------------------------------------
@@ -348,6 +352,136 @@ def _is_deadline_error(e: BaseException) -> bool:
 # frame prefix on every KV value: jaxlib 0.4.37's bytes API segfaults
 # reading values shorter than 2 bytes, and barriers gather b"" payloads
 _KV_FRAME = b"LT1\x00"
+
+# ----------------------------------------------------------------------
+# chunked KV payloads.  The coordination-service KV store is built for
+# small config values; multi-MB blobs (elected-histogram allgathers on
+# the XLA:CPU transport, wide-matrix find-bin states) are split across
+# framed continuation keys with a per-chunk CRC.  The head value either
+# carries the whole payload (_KV_RAW) or a descriptor + the first chunk
+# (_KV_CHUNKED); continuation chunks are written BEFORE the head, so a
+# reader that sees the head never waits on a missing chunk — no extra
+# synchronization round is needed and program-order GC still holds.
+# ----------------------------------------------------------------------
+_KV_RAW = b"R"
+_KV_CHUNKED = b"C"
+_KV_CHUNK_HDR = struct.Struct("<IQ")  # (num_chunks, total_len)
+_KV_CHUNK_ENV = "LIGHTGBM_TPU_KV_CHUNK"
+_KV_CHUNK_DEFAULT = 4 * 1024 * 1024
+# (uid, rank) -> number of continuation keys written (for lazy GC; the
+# rank in the key matters only for in-process multi-rank simulations,
+# where all ranks share this module)
+_chunks_written: Dict[Tuple[int, int], int] = {}
+
+
+def kv_chunk_limit() -> int:
+    """Max payload bytes carried by a single KV value (env-overridable;
+    tests shrink it to force chunking on tiny blobs)."""
+    raw = os.environ.get(_KV_CHUNK_ENV, "").strip()
+    if raw:
+        try:
+            return max(int(raw), 1)
+        except ValueError:
+            Log.warning("Unparsable %s=%r ignored", _KV_CHUNK_ENV, raw)
+    return _KV_CHUNK_DEFAULT
+
+
+def _frame_chunk(chunk: bytes) -> bytes:
+    return struct.pack("<I", zlib.crc32(chunk) & 0xFFFFFFFF) + chunk
+
+
+def _unframe_chunk(raw: bytes, what: str, key: str) -> bytes:
+    if len(raw) < 4:
+        raise NetError(f"{what}: truncated KV chunk at {key}")
+    want = struct.unpack("<I", raw[:4])[0]
+    chunk = raw[4:]
+    got = zlib.crc32(chunk) & 0xFFFFFFFF
+    if got != want:
+        raise NetError(
+            f"{what}: KV chunk CRC mismatch at {key} "
+            f"(stored {want:#010x}, computed {got:#010x}) — payload "
+            f"corrupted in the coordination store")
+    return chunk
+
+
+def _kv_put_payload(client, uid: int, rank: int, key: str, blob: bytes,
+                    deadline: float, what: str) -> None:
+    """Write ``blob`` under ``key``, splitting payloads larger than the
+    chunk limit across ``ltpu_chunk/`` continuation keys (written first,
+    see the protocol note above)."""
+    limit = kv_chunk_limit()
+    if len(blob) <= limit:
+        retry_call(lambda: _kv_put(client, key, _KV_RAW + blob),
+                   what=f"{what}[set uid={uid}]", deadline_s=deadline)
+        return
+    chunks = [blob[i:i + limit] for i in range(0, len(blob), limit)]
+    for i in range(1, len(chunks)):
+        ckey = f"{_CHUNK_DIR}{uid}/{rank}/{i}"
+        framed = _frame_chunk(chunks[i])
+        retry_call(lambda k=ckey, v=framed: _kv_put(client, k, v),
+                   what=f"{what}[set chunk uid={uid}/{i}]",
+                   deadline_s=deadline)
+    _chunks_written[(uid, rank)] = len(chunks) - 1
+    tracer.counter("net.kv_chunk", float(len(chunks) - 1), what=what)
+    head = (_KV_CHUNKED
+            + _KV_CHUNK_HDR.pack(len(chunks), len(blob))
+            + _frame_chunk(chunks[0]))
+    retry_call(lambda: _kv_put(client, key, head),
+               what=f"{what}[set uid={uid}]", deadline_s=deadline)
+
+
+def _kv_read_payload(client, uid: int, r: int, head: bytes, poll_ms: int,
+                     budget_left: Callable[[], float],
+                     watch: Optional["PeerWatch"], what: str) -> bytes:
+    """Decode one rank's head value, fetching continuation chunks if the
+    payload was split.  Chunks exist before the head is visible, so the
+    bounded gets here only absorb store latency, not peer skew."""
+    if head[:1] == _KV_RAW:
+        return head[1:]
+    if head[:1] != _KV_CHUNKED:
+        raise NetError(
+            f"{what}: unrecognized KV payload framing {head[:1]!r} from "
+            f"rank {r} (version skew between ranks?)")
+    nchunks, total = _KV_CHUNK_HDR.unpack_from(head, 1)
+    parts = [_unframe_chunk(head[1 + _KV_CHUNK_HDR.size:], what,
+                            f"{_COLLECT_DIR}{uid}/{r}")]
+    for i in range(1, nchunks):
+        key = f"{_CHUNK_DIR}{uid}/{r}/{i}"
+        while True:
+            left = budget_left()
+            if left <= 0:
+                if watch is not None:
+                    watch.check(what)
+                tracer.counter("net.timeout", what=what)
+                raise CollectiveTimeoutError(
+                    f"{what} uid={uid}: chunk {i}/{nchunks} from rank {r} "
+                    f"never appeared within the budget")
+            try:
+                raw = _kv_get(client, key, poll_ms)
+                break
+            except Exception as e:
+                if not _is_deadline_error(e):
+                    raise NetError(
+                        f"{what} uid={uid}: KV store error reading chunk "
+                        f"{key}: {e}") from e
+                if watch is not None:
+                    watch.check(what)
+        parts.append(_unframe_chunk(raw, what, key))
+    blob = b"".join(parts)
+    if len(blob) != total:
+        raise NetError(
+            f"{what} uid={uid}: reassembled payload from rank {r} is "
+            f"{len(blob)} bytes, descriptor said {total}")
+    return blob
+
+
+def _gc_chunks(client, uid: int, rank: int) -> None:
+    cnt = _chunks_written.pop((uid, rank), 0)
+    for i in range(1, cnt + 1):
+        try:
+            client.key_value_delete(f"{_CHUNK_DIR}{uid}/{rank}/{i}")
+        except Exception:  # pragma: no cover - GC is best-effort
+            pass
 
 
 def _kv_put(client, key: str, blob: bytes) -> None:
@@ -599,8 +733,7 @@ def kv_gather(uid: int, blob: bytes, *, client=None, rank: Optional[int] = None,
     poll_ms = max(int(s.poll_s() * 1e3), 10)
 
     own_key = f"{_COLLECT_DIR}{uid}/{rank}"
-    retry_call(lambda: _kv_put(client, own_key, blob),
-               what=f"{what}[set uid={uid}]", deadline_s=deadline)
+    _kv_put_payload(client, uid, rank, own_key, blob, deadline, what)
 
     t0 = time.monotonic()
     out: List[bytes] = []
@@ -624,7 +757,10 @@ def kv_gather(uid: int, blob: bytes, *, client=None, rank: Optional[int] = None,
                     f"look alive", elapsed_s=elapsed,
                 )
             try:
-                out.append(_kv_get(client, key, poll_ms))
+                head = _kv_get(client, key, poll_ms)
+                out.append(_kv_read_payload(
+                    client, uid, r, head, poll_ms,
+                    lambda: budget - (time.monotonic() - t0), watch, what))
                 break
             except Exception as e:
                 if not _is_deadline_error(e):
@@ -646,6 +782,7 @@ def kv_gather(uid: int, blob: bytes, *, client=None, rank: Optional[int] = None,
     if uid > 0:
         try:
             client.key_value_delete(f"{_COLLECT_DIR}{uid - 1}/{rank}")
+            _gc_chunks(client, uid - 1, rank)
             tracer.counter("net.kv_gc")
         except Exception:  # pragma: no cover - GC is best-effort
             pass

@@ -22,6 +22,13 @@ modes:
              failure additionally flushes the crash flight recorder
              (obs/flight.py) — the ``report merge``/crash-dump
              acceptance legs ride this mode.
+  wfeature / wvoting — full lgb.train over the host-driven
+             feature-parallel / voting-parallel learner
+             (parallel/hostlearner.py); the faulted rank dies mid-
+             collective and every survivor must classify a typed
+             PeerFailureError within the bound and leave with exit
+             code 75 — the wide learners share the hardened
+             transport's failure semantics unchanged.
   mergetrace — clean 2-rank "training" loop (compute span + hardened
              barrier per iteration, KV transport) with per-rank traces;
              MERGETRACE_COMPUTE_S skews one rank into a straggler so
@@ -119,6 +126,42 @@ if mode in ("gather", "barrier"):
                 "elapsed": e.elapsed_s, "wall": time.time() - t_enter})
     print(f"rank {rank} {mode} recorded failure; hard exit")
     net.hard_exit(0)  # the atexit shutdown barrier would hang on the corpse
+
+if mode in ("wfeature", "wvoting"):
+    # wide-data learners ride the same hardened collect.allgather_bytes
+    # path, so die:N lands inside a histogram/best-split/vote exchange
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.cli import EXIT_PEER_FAILURE
+
+    learner = "feature" if mode == "wfeature" else "voting"
+    rng = np.random.default_rng(13)
+    N, F = 1200, 20
+    X = rng.integers(0, 12, size=(N, F)).astype(np.float32)
+    w = rng.standard_normal(F)
+    y = (rng.random(N) < 1.0 / (1.0 + np.exp(-((X - 6) @ w * 0.2)))
+         ).astype(np.float32)
+    p = dict(objective="binary", tree_learner=learner, num_machines=nproc,
+             boost_from_average=False, num_leaves=15, min_data_in_leaf=20,
+             top_k=4, verbose=-1)
+    if learner == "voting":
+        p["pre_partition"] = True
+        sl = slice(rank * N // nproc, (rank + 1) * N // nproc)
+        ds = lgb.Dataset(X[sl], label=y[sl], params=dict(p))
+    else:
+        ds = lgb.Dataset(X, label=y, params=dict(p))
+    t0 = time.time()
+    try:
+        bst = lgb.train(dict(p), ds, 10, verbose_eval=False)
+    except net.PeerFailureError as e:
+        _write({"error": "PeerFailureError", "ranks": list(e.ranks),
+                "elapsed": e.elapsed_s, "wall": time.time() - t0})
+        print(f"rank {rank} {mode}: peer failure after {e.elapsed_s:.1f}s")
+        net.hard_exit(EXIT_PEER_FAILURE)
+    _write({"error": None, "trees": bst.num_trees})
+    print(f"rank {rank} {mode} UNEXPECTED clean finish")
+    sys.exit(2)
 
 if mode == "train":
     # acceptance leg (ISSUE 5): each rank trains the SAME data locally

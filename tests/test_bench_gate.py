@@ -255,6 +255,96 @@ def test_quantized_clean_run_passes(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# comms leg (wide-data learners, docs/PARALLEL.md)
+# ----------------------------------------------------------------------
+def _comms(ratio=48.0, rows=3000, features=2000, ranks=2,
+           data_s=0.9, feature_s=0.1, voting_s=0.2):
+    return {
+        "rows": rows, "features": features, "ranks": ranks,
+        "voting_vs_data_payload_ratio": ratio,
+        "feature_vs_data_payload_ratio": 1800.0,
+        "per_learner": {
+            "data": {"bytes_per_iter": 5_568_062, "s_per_iter": data_s},
+            "feature": {"bytes_per_iter": 3_031, "s_per_iter": feature_s},
+            "voting": {"bytes_per_iter": 114_902, "s_per_iter": voting_s},
+        },
+    }
+
+
+def test_comms_payload_gate_fires_without_prior(tmp_path):
+    """Voting must cut the data-parallel allreduce payload >=5x; the
+    ratio is protocol arithmetic, so it gates with no prior capture."""
+    out = {"metric": METRIC, "value": 0.10, "comms": _comms(ratio=3.2)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 1
+    assert out["regression_comms_payload"] is True
+    assert out["gate_comms"]["min_voting_vs_data_payload_ratio"] == 5.0
+    assert out["gate_comms"]["voting_vs_data_payload_ratio"] == pytest.approx(3.2)
+
+
+def test_comms_payload_gate_is_device_independent(tmp_path):
+    # bytes/iter do not depend on the backend: the leg runs (and fires)
+    # even on a backend_fallback capture that skips every other gate
+    out = {"metric": METRIC, "value": 9.9, "backend_fallback": True,
+           "comms": _comms(ratio=3.2)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 1
+    assert out["regression_comms_payload"] is True
+    assert "regression" not in out  # headline leg still skipped
+    out = {"metric": METRIC, "value": 9.9, "backend_fallback": True,
+           "comms": _comms(ratio=48.0)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+    assert "gate_comms" in out
+
+
+def test_comms_payload_gate_passes(tmp_path):
+    out = {"metric": METRIC, "value": 0.10, "comms": _comms(ratio=48.46)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+    assert out["gate_comms"]["voting_vs_data_payload_ratio"] == pytest.approx(48.46)
+    for k in list(out):
+        assert not k.startswith("regression"), k
+
+
+def test_comms_wall_gate_against_prior(tmp_path):
+    _capture(tmp_path, "BENCH_r01.json", 0.10, comms=_comms(data_s=1.0))
+    out = {"metric": METRIC, "value": 0.10,
+           "comms": _comms(data_s=1.2)}  # 20% slower: over the band
+    rc = bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={})
+    assert rc == 1
+    assert out["regression_comms_wall"] is True
+    assert out["gate_comms_wall"]["data"]["best_prior_s_per_iter"] == 1.0
+    # within the 1.10 band passes
+    out = {"metric": METRIC, "value": 0.10, "comms": _comms(data_s=1.05)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+    assert "regression_comms_wall" not in out
+
+
+def test_comms_wall_gate_requires_same_grid(tmp_path):
+    # a prior at another (rows, features, ranks) grid is not comparable,
+    # and fallback priors are never a wall-clock baseline
+    _capture(tmp_path, "BENCH_r01.json", 0.10,
+             comms=_comms(features=500, data_s=0.01))
+    _capture(tmp_path, "BENCH_r02.json", 0.10,
+             comms=_comms(data_s=0.01), backend_fallback=True)
+    out = {"metric": METRIC, "value": 0.10, "comms": _comms(data_s=9.9)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+    assert "gate_comms_wall" not in out and "regression_comms_wall" not in out
+
+
+def test_comms_section_error_never_gates(tmp_path):
+    out = {"metric": METRIC, "value": 0.10,
+           "comms": {"error": "RuntimeError: boom",
+                     "voting_vs_data_payload_ratio": 0.1}}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+    assert "gate_comms" not in out
+
+
+def test_comms_opt_out(tmp_path):
+    out = {"metric": METRIC, "value": 0.10, "comms": _comms(ratio=0.1)}
+    rc = bench.apply_regression_gate(out, bench_dir=str(tmp_path),
+                                     env={"BENCH_GATE": "0"})
+    assert rc == 0 and "gate_comms" not in out
+
+
+# ----------------------------------------------------------------------
 # multi-model leg
 # ----------------------------------------------------------------------
 def test_multimodel_admission_gate(tmp_path):

@@ -237,7 +237,8 @@ class TestKvGather:
     def test_gather_returns_process_order(self):
         c = FakeClient()
         net.configure(deadline_s=2.0)
-        net._kv_put(c, "ltpu_collect/0/1", b"from-rank-1")
+        net._kv_put_payload(c, 0, 1, "ltpu_collect/0/1", b"from-rank-1",
+                            2.0, "test")
         out = net.kv_gather(0, b"from-rank-0", client=c, rank=0, nproc=2)
         assert out == [b"from-rank-0", b"from-rank-1"]
 
@@ -252,10 +253,10 @@ class TestKvGather:
     def test_lazy_gc_deletes_own_previous_uid(self):
         c = FakeClient()
         net.configure(deadline_s=2.0)
-        net._kv_put(c, "ltpu_collect/0/1", b"x")
+        net._kv_put_payload(c, 0, 1, "ltpu_collect/0/1", b"x", 2.0, "test")
         net.kv_gather(0, b"a", client=c, rank=0, nproc=2)
         assert "ltpu_collect/0/0" in c.store  # nothing to GC yet
-        net._kv_put(c, "ltpu_collect/1/1", b"y")
+        net._kv_put_payload(c, 1, 1, "ltpu_collect/1/1", b"y", 2.0, "test")
         net.kv_gather(1, b"b", client=c, rank=0, nproc=2)
         # completing uid 1 proves every rank read our uid-0 key
         assert "ltpu_collect/0/0" not in c.store
@@ -345,3 +346,74 @@ class TestErrorHierarchyAndExitCodes:
 
         assert parallel.PeerFailureError is net.PeerFailureError
         assert parallel.CollectiveTimeoutError is net.CollectiveTimeoutError
+
+
+# ----------------------------------------------------------------------
+class TestChunkedKv:
+    """Chunked KV payloads: multi-MB blobs split across framed
+    continuation keys with per-chunk CRC (elected-histogram allgathers
+    on the XLA:CPU transport exceed single-value comfort zones)."""
+
+    def _gather(self, nproc, payloads, client=None, uid=0):
+        c = client if client is not None else FakeClient()
+        net.configure(deadline_s=5.0)
+        res = {}
+
+        def run(r):
+            res[r] = net.kv_gather(uid, payloads[r], client=c, rank=r,
+                                   nproc=nproc)
+
+        ts = [threading.Thread(target=run, args=(r,)) for r in range(nproc)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return res, c
+
+    @pytest.mark.parametrize("size", [1, 1024, 8 * 1024 * 1024])
+    def test_roundtrip_sizes(self, size, monkeypatch):
+        # 256 KiB chunk limit keeps the 8 MiB leg fast while still
+        # forcing a 32-chunk reassembly
+        monkeypatch.setenv("LIGHTGBM_TPU_KV_CHUNK", str(256 * 1024))
+        payloads = [bytes([r]) * size + bytes([r])  # size+1, rank-tagged
+                    for r in range(2)]
+        res, _ = self._gather(2, payloads)
+        assert res[0] == payloads and res[1] == payloads
+
+    def test_small_payload_stays_single_key(self):
+        res, c = self._gather(2, [b"a" * 100, b"b"])
+        assert res[0] == [b"a" * 100, b"b"]
+        assert not any(k.startswith("ltpu_chunk/") for k in c.store)
+
+    def test_chunk_keys_gced_after_next_gather(self, monkeypatch):
+        monkeypatch.setenv("LIGHTGBM_TPU_KV_CHUNK", "64")
+        payloads = [b"x" * 500, b"y" * 300]
+        res, c = self._gather(2, payloads)
+        assert res[1] == payloads
+        assert any(k.startswith("ltpu_chunk/0/") for k in c.store)
+        res2, _ = self._gather(2, [b"p" * 200, b"q"], client=c, uid=1)
+        assert res2[0] == [b"p" * 200, b"q"]
+        # completing uid 1 proves every rank read uid 0 -> chunks GC'd
+        assert not any(k.startswith("ltpu_chunk/0/") for k in c.store)
+
+    def test_crc_mismatch_is_typed_corruption_error(self, monkeypatch):
+        monkeypatch.setenv("LIGHTGBM_TPU_KV_CHUNK", "64")
+        c = FakeClient()
+        net.configure(deadline_s=2.0)
+        net._kv_put_payload(c, 0, 1, "ltpu_collect/0/1", b"z" * 500,
+                            2.0, "test")
+        key = "ltpu_chunk/0/1/1"
+        raw = bytearray(c.store[key])
+        raw[-1] ^= 0xFF  # flip a payload byte under the stored CRC
+        with c.lock:
+            c.store[key] = bytes(raw)
+        with pytest.raises(net.NetError, match="CRC mismatch"):
+            net.kv_gather(0, b"mine", client=c, rank=0, nproc=2)
+
+    def test_chunk_limit_env_and_default(self, monkeypatch):
+        monkeypatch.delenv("LIGHTGBM_TPU_KV_CHUNK", raising=False)
+        assert net.kv_chunk_limit() == 4 * 1024 * 1024
+        monkeypatch.setenv("LIGHTGBM_TPU_KV_CHUNK", "123")
+        assert net.kv_chunk_limit() == 123
+        monkeypatch.setenv("LIGHTGBM_TPU_KV_CHUNK", "bogus")
+        assert net.kv_chunk_limit() == 4 * 1024 * 1024

@@ -252,6 +252,31 @@ def test_report_merge_attributes_straggler_on_real_2rank_run(tmp_path):
 @pytest.mark.slow
 @pytest.mark.faultinject
 @pytest.mark.netfault
+@pytest.mark.parametrize("mode", ["wfeature", "wvoting"])
+def test_sigkill_during_wide_learner_training(tmp_path, mode):
+    """The feature-parallel and voting-parallel learners inherit the
+    hardened transport's failure semantics unchanged: SIGKILL one rank
+    mid-training and the survivor classifies a typed PeerFailureError
+    naming the corpse within the detection bound, then leaves with the
+    retryable exit code 75 (docs/ROBUSTNESS.md)."""
+    out = str(tmp_path / mode)
+    port = _free_port()
+    procs = [
+        _spawn(r, 2, port, out, mode,
+               extra_env={"LIGHTGBM_TPU_FAULT": "die:6"} if r == 1 else None)
+        for r in range(2)
+    ]
+    logs = [p.communicate(timeout=240)[0] for p in procs]
+    assert procs[1].returncode == -signal.SIGKILL, logs[1][-2000:]
+    assert procs[0].returncode == 75, logs[0][-2000:]  # EXIT_PEER_FAILURE
+    res = _result(out, 0)
+    assert res["error"] == "PeerFailureError" and res["ranks"] == [1], res
+    assert res["elapsed"] <= DETECT_BOUND, res
+
+
+@pytest.mark.slow
+@pytest.mark.faultinject
+@pytest.mark.netfault
 def test_sigkill_mid_barrier(tmp_path):
     """Same detection contract when the collective is a bare barrier."""
     out = str(tmp_path / "b")
