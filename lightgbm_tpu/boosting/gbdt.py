@@ -151,6 +151,9 @@ class GBDT:
             max_depth=config.max_depth,
             use_missing=config.use_missing,
             top_k=config.top_k,
+            quantized=config.quantized_training,
+            quant_bits=config.quantized_grad_bits,
+            quant_seed=config.seed,
         )
         # tree-learner dispatch (TreeLearner::CreateTreeLearner,
         # tree_learner.cpp:9-33): serial on one chip, or a sharded learner
@@ -422,24 +425,37 @@ class GBDT:
 
             should_continue = False
             leaves_grown = 0
+            # quantized training (use_quantized_grad): grad/hess go to the
+            # learner as stochastically-rounded int16 with a per-class
+            # global scale.  The host-driven parallel learners quantize
+            # internally (they must allgather the scale maxima first).
+            quantize = (self.config.quantized_training
+                        and not getattr(self.learner,
+                                        "quantizes_internally", False))
             for k in range(self.num_tree_per_iteration):
                 feature_mask = self._feature_mask()
                 with timetag.phase("tree"):
+                    gk, hk, qscale = grad[k], hess[k], None
+                    if quantize:
+                        gk, hk, qscale = self._quantize_class(gk, hk, k)
                     if self.learner is not None:
                         gr = self.learner.grow(
-                            self.bins, grad[k], hess[k], self.select, feature_mask,
+                            self.bins, gk, hk, self.select, feature_mask,
                             self.meta, self.hyper,
+                            **({"qscale": qscale} if qscale is not None
+                               else {}),
                         )
                     else:
                         gr = grow_tree(
                             self.bins,
-                            grad[k],
-                            hess[k],
+                            gk,
+                            hk,
                             self.select,
                             feature_mask,
                             self.meta,
                             self.hyper,
                             self.grow_params,
+                            qscale=qscale,
                         )
                     fence(gr)
                 num_splits = int(gr.num_splits)
@@ -574,6 +590,25 @@ class GBDT:
     def _adjust_gradients(self, grad, hess):
         """Hook for GOSS's gradient re-weighting; identity for GBDT."""
         return grad, hess
+
+    def _quantize_class(self, gk, hk, k: int):
+        """Quantize one class's (N,) grad/hess to int16 for the exact
+        integer histogram path (ops/qhist.py).
+
+        The scale is global over the selected rows (single-process: the
+        local abs-max IS global) and the stochastic-rounding seed is
+        value-keyed plus an (iteration, class) salt, so replays and row
+        shuffles reproduce the same quantized vectors bit for bit."""
+        from ..ops import qhist
+
+        bits = self.config.quantized_grad_bits
+        mx = np.asarray(qhist.local_absmax(gk, hk, self.select))
+        qscale_np = qhist.scales_from_max(mx[0], mx[1], bits)
+        seed = (int(self.config.seed) * 2654435761
+                + self.iter * 97 + k * 131071 + 1) & 0xFFFFFFFF
+        qscale = jnp.asarray(qscale_np)
+        gq, hq = qhist.quantize_rows(gk, hk, qscale, np.uint32(seed), bits)
+        return gq, hq, qscale
 
     def _add_tree_to_valid_scores(self, tree: Tree, k: int) -> None:
         self._add_trees_to_valid_scores([tree], k)

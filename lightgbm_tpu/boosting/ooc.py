@@ -62,6 +62,7 @@ from ..ops.ooc import (
     subtract_sibling,
 )
 from ..ops.predict import predict_binned
+from ..ops.qhist import dequantize_hist, dequantize_sums
 from ..ops.split import NEG_INF
 from ..utils.log import Log
 
@@ -190,31 +191,45 @@ class OocTrainer:
 
     # ------------------------------------------------------------------
     def grow(self, bins_ignored, grad, hess, select, feature_mask,
-             meta, hyper) -> GrowResult:
+             meta, hyper, qscale=None) -> GrowResult:
         """Grow one leaf-wise tree, streaming the matrix per pass.
 
         Host-driven replay of ``grow_tree``'s best-first loop: the
         per-leaf tables live on host as np.float32 (f32 round-trips are
         exact; ``np.argmax`` keeps the same first-max tie-break), the
-        histograms live on device and accumulate chunk-by-chunk."""
+        histograms live on device and accumulate chunk-by-chunk.
+
+        Quantized training: int16 ``grad``/``hess`` (plus the (2,)
+        ``qscale``) switch the streamed folds to exact int32 — integer
+        adds are associative, so the chunk grid cannot perturb the
+        histogram AT ALL (the f32 contract needs ROW_BLOCK-aligned
+        boundaries for that) — and dequantization happens once per
+        node, just before the split scan."""
         L = self.params.num_leaves
         B = self.params.num_bins
         rb = self.params.row_block
         use_missing = self.params.use_missing
         stats0 = dict(self.stats.as_dict())
+        quant = jnp.issubdtype(grad.dtype, jnp.integer)
+        if quant and qscale is None:
+            raise ValueError("integer grad/hess require the qscale argument")
+        deq = (lambda h: dequantize_hist(h, qscale)) if quant else (lambda h: h)
 
         with tracer.span("ooc.grow", tree=self._trees_grown,
                          chunks=self.plan.num_chunks):
             # ---- root: LeafSplits::Init on the resident vectors + one
             # streamed histogram pass
             sums_dev = root_totals(grad, hess, select)
-            hist = jnp.zeros((self.num_features, B, 3), jnp.float32)
+            if quant:
+                sums_dev = dequantize_sums(sums_dev, qscale)
+            hist = jnp.zeros((self.num_features, B, 3),
+                             jnp.int32 if quant else jnp.float32)
             for _i, start, _stop, chunk in self._stream():
                 hist = root_hist_chunk(hist, chunk, grad, hess, select,
                                        np.int32(start), B, rb)
             root_sums = np.asarray(sums_dev, np.float32)
-            root_res = find_best_split(hist, sums_dev, feature_mask, True,
-                                       meta, hyper, use_missing)
+            root_res = find_best_split(deq(hist), sums_dev, feature_mask,
+                                       True, meta, hyper, use_missing)
 
             # host-side per-leaf tables (np.float32 throughout: any f64
             # promotion here would change the replayed arithmetic)
@@ -299,9 +314,9 @@ class OocTrainer:
                 child_depth = int(leaf_depth[bl]) + 1
                 depth_ok = (self.params.max_depth <= 0
                             or child_depth < self.params.max_depth)
-                lres = find_best_split(left_hist, left, feature_mask,
+                lres = find_best_split(deq(left_hist), left, feature_mask,
                                        depth_ok, meta, hyper, use_missing)
-                rres = find_best_split(right_hist, right, feature_mask,
+                rres = find_best_split(deq(right_hist), right, feature_mask,
                                        depth_ok, meta, hyper, use_missing)
 
                 rec_i["leaf"][s] = bl

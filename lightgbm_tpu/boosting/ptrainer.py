@@ -1496,6 +1496,11 @@ def eligible(config, train_set, objective, num_tree_per_iteration: int) -> bool:
         return False
     if objective is None:
         return False
+    # quantized training runs through the mask grower's int32 histogram
+    # path (ops/qhist.py); the fused kernels' bf16 3-term value split is
+    # an f32 pipeline and would break the exact-integer contract
+    if getattr(config, "quantized_training", False):
+        return False
     if num_tree_per_iteration == 1:
         if not getattr(objective, "rowwise", False):
             return False

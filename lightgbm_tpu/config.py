@@ -102,6 +102,7 @@ PARAM_ALIASES: Dict[str, str] = {
     "num_classes": "num_class",
     "unbalanced_sets": "is_unbalance",
     "bagging_fraction_seed": "bagging_seed",
+    "use_quantized_grad": "quantized_training",
 }
 
 
@@ -193,6 +194,18 @@ class Config:
     out_of_core: str = "auto"
     ooc_chunk_rows: int = 0
     ooc_prefetch_depth: int = 2
+
+    # --- quantized training (ops/qhist.py; TPU-specific extension
+    # mirroring the reference's use_quantized_grad).  Off by default —
+    # and OFF is bit-identical to builds without the feature.  On:
+    # per-row grad/hess quantize to int16 levels under a per-iteration
+    # global scale with stochastic rounding, histograms accumulate in
+    # exact int32 (deterministic across row orders, chunkings and rank
+    # counts), distributed histogram exchanges ship the 3x-smaller
+    # int16 hist_q wire, and dequantization happens at split-scan time.
+    # quantized_grad_bits: signed level width (2..15; 5 = QMAX 15).
+    quantized_training: bool = False
+    quantized_grad_bits: int = 5
 
     # --- tree (TreeConfig, config.h:189–234)
     min_data_in_leaf: int = 20
@@ -354,6 +367,11 @@ class Config:
         if self.ooc_prefetch_depth < 1:
             Log.fatal("ooc_prefetch_depth must be >= 1, got %d",
                       self.ooc_prefetch_depth)
+        if not (2 <= self.quantized_grad_bits <= 15):
+            # >15 would let a single row overflow the int16 wire plane;
+            # <2 leaves no signed levels at all
+            Log.fatal("quantized_grad_bits must be in [2, 15], got %d",
+                      self.quantized_grad_bits)
         if self.network_timeout <= 0:
             Log.fatal("network_timeout must be > 0, got %s", self.network_timeout)
         if self.network_retries < 0:

@@ -67,6 +67,43 @@ def load_trace(path: str, warn: bool = True) -> List[Dict[str, Any]]:
     return records
 
 
+def net_bytes_by_purpose(records: List[Dict[str, Any]]) -> Dict[str, float]:
+    """Total ``net.bytes`` counter value per purpose tag (``hist``,
+    ``hist_q``, ``best_split``, ...) across a trace stream."""
+    out: Dict[str, float] = {}
+    for r in records:
+        if r.get("ev") == "counter" and r.get("name") == "net.bytes":
+            p = str(r.get("purpose", "misc"))
+            out[p] = out.get(p, 0.0) + float(r.get("value", 0.0))
+    return out
+
+
+def quantized_wire_summary(purpose_bytes: Dict[str, float],
+                           iters: int) -> Optional[Dict[str, Any]]:
+    """Quantized-vs-f32 histogram payload accounting from the purpose
+    ledger.  ``hist_q`` blobs are int16 (g,h) planes — by wire-format
+    arithmetic the f32x3 payload for the SAME histograms is exactly 3x
+    the bytes (F*B*12 vs F*B*4) — so the f32 equivalent is derivable
+    without a second run.  Returns None when no histogram purpose was
+    seen.  ``ratio`` is f32-equivalent over actually-sent histogram
+    bytes: 1.0 for an unquantized run, approaching 3.0 when every
+    histogram rides the quantized wire."""
+    hq = purpose_bytes.get("hist_q", 0.0)
+    hf = purpose_bytes.get("hist", 0.0)
+    if hq <= 0 and hf <= 0:
+        return None
+    sent = hq + hf
+    equiv = 3.0 * hq + hf
+    n = max(iters, 1)
+    return {
+        "hist_q_bytes": int(hq),
+        "hist_f32_bytes": int(hf),
+        "hist_q_bytes_per_iter": round(hq / n, 1),
+        "f32_equiv_bytes_per_iter": round(equiv / n, 1),
+        "ratio": round(equiv / sent, 3) if sent > 0 else None,
+    }
+
+
 def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     spans: Dict[str, List[float]] = {}
     iters: List[Dict[str, Any]] = []
@@ -124,6 +161,15 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "peak_host_rss_mb": round(peak_host, 1),
         "peak_dev_mb": round(peak_dev, 1),
     }
+    purpose_bytes = net_bytes_by_purpose(records)
+    if purpose_bytes:
+        out["net_bytes_by_purpose"] = {
+            k: int(v) for k, v in sorted(purpose_bytes.items(),
+                                         key=lambda kv: -kv[1])
+        }
+        qw = quantized_wire_summary(purpose_bytes, len(iters))
+        if qw is not None:
+            out["quantized_wire"] = qw
     if ingest_done:
         out["ingest"] = ingest_done
     if iters:
@@ -193,6 +239,16 @@ def render(summary: Dict[str, Any], path: str = "") -> str:
         + (f", device {summary['peak_dev_mb']:.0f} MB"
            if summary["peak_dev_mb"] else "")
     )
+    qw = summary.get("quantized_wire")
+    if qw:
+        ratio = qw.get("ratio")
+        lines.append(
+            "histogram wire: "
+            f"quantized {qw['hist_q_bytes_per_iter']:.0f} B/iter, "
+            f"f32-equivalent {qw['f32_equiv_bytes_per_iter']:.0f} B/iter"
+            + (f" ({ratio:.2f}x payload reduction)"
+               if ratio is not None else "")
+        )
     ing = summary.get("ingest")
     if ing:
         lines.append(
@@ -334,6 +390,14 @@ def merge_summary(by_rank: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
             "bytes_per_iter": round(nbytes / len(common), 1) if common
             else 0.0,
         }
+        # quantized-training wire accounting: per-rank histogram-payload
+        # ratio (f32-equivalent / sent; 1.0 = unquantized, ->3.0 = fully
+        # quantized) from the purpose-tagged net.bytes counters
+        qw = quantized_wire_summary(
+            net_bytes_by_purpose(by_rank[rank]), len(common))
+        if qw is not None:
+            per_rank[rank]["hist_q_bytes"] = qw["hist_q_bytes"]
+            per_rank[rank]["quantized_ratio"] = qw["ratio"]
     out: Dict[str, Any] = {
         "ranks": ranks,
         "world_size": (sorted(worlds)[-1] if worlds else len(ranks)),
@@ -375,15 +439,21 @@ def render_merge(m: Dict[str, Any]) -> str:
         f"world={m['world_size']}, {m['aligned_iterations']} aligned "
         f"iteration(s){rid} ===")
     ranks = m["ranks"]
+    # quantized-wire column only when some rank exchanged histograms
+    show_q = any("quantized_ratio" in m["per_rank"][r] for r in ranks)
     lines.append("")
     lines.append(f"{'rank':<8}{'iters':>7}{'wall_s':>10}{'compute_s':>11}"
-                 f"{'barrier_wait_s':>16}{'bytes/iter':>12}")
+                 f"{'barrier_wait_s':>16}{'bytes/iter':>12}"
+                 + (f"{'q_ratio':>9}" if show_q else ""))
     for r in ranks:
         pr = m["per_rank"][r]
+        qr = pr.get("quantized_ratio")
         lines.append(f"{r:<8}{pr['aligned_iterations']:>7}"
                      f"{pr['wall_s']:>10.3f}{pr['compute_s']:>11.3f}"
                      f"{pr['barrier_wait_s']:>16.3f}"
-                     f"{pr.get('bytes_per_iter', 0.0):>12.0f}")
+                     f"{pr.get('bytes_per_iter', 0.0):>12.0f}"
+                     + ((f"{qr:>9.2f}" if qr is not None else f"{'-':>9}")
+                        if show_q else ""))
     st = m.get("straggler")
     if st:
         share = st["slowest_rank_share"]

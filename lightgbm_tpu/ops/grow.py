@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 
 from .histogram import ROW_BLOCK, build_histogram
+from .qhist import QUANT_BITS, dequantize_hist, dequantize_sums
 from .split import (
     NEG_INF,
     FeatureMeta,
@@ -70,6 +71,11 @@ class GrowParams(NamedTuple):
     top_k: int = 20  # voting: top-k voted features (config top_k)
     num_machines: int = 1  # voting: local-constraint scaling divisor
     compact: bool = True  # tiered leaf-row compaction (see _tiers)
+    # quantized training (ops/qhist.py): int16 grad/hess levels in, int32
+    # histogram pool, dequantization at split-scan time only
+    quantized: bool = False
+    quant_bits: int = QUANT_BITS
+    quant_seed: int = 0  # stochastic-rounding key base (config seed)
 
 
 # Smallest compaction tier.  Below ~4x this, the masked full-scan is
@@ -175,21 +181,34 @@ def grow_tree(
     meta: FeatureMeta,
     hyper: SplitHyper,
     params: GrowParams,
+    qscale: jnp.ndarray = None,
 ) -> GrowResult:
     """Grow one leaf-wise tree.  See module docstring.
 
     Under a parallel mode this must be called inside ``shard_map`` over a
     mesh axis named ``params.axis_name`` (parallel/learner.py does this);
     ``bins``/``grad``/``hess``/``select`` are then the per-shard blocks.
+
+    Quantized training: when ``grad``/``hess`` arrive as int16 levels
+    (ops/qhist.quantize_rows), the whole histogram pool switches to
+    exact int32 accumulation — the subtraction trick becomes an integer
+    identity and psum order stops mattering — and ``qscale`` (the (2,)
+    global scales) dequantizes once, at split-scan time.
     """
     n, f = bins.shape
     L = params.num_leaves
     B = params.num_bins
     mode = params.parallel
     ax = params.axis_name
+    quantized = jnp.issubdtype(grad.dtype, jnp.integer)
+    if quantized and qscale is None:
+        raise ValueError("integer grad/hess require the qscale argument")
     tiers = (
         _tiers(n, include_full=params.parallel in ("data", "voting"))
-        if params.compact
+        if params.compact and not quantized
+        # the compaction gather bitcasts f32 value columns into int32
+        # words — meaningless for int16 levels, and the masked full scan
+        # keeps quantized accumulation exact; so quantized runs un-tiered
         else []
     )
 
@@ -272,16 +291,20 @@ def grow_tree(
         voting); sums: GLOBAL leaf totals."""
         sg, sh, sc = sums[0], sums[1], sums[2]
         if mode == "voting":
+            # quantized: ballots are cast from the dequantized LOCAL
+            # hist; the elected columns are psum'd in exact int32 FIRST
+            # and dequantized once after the reduction
+            lhist = dequantize_hist(hist, qscale) if quantized else hist
             # 1. local proposals from LOCAL hist with /num_machines
             #    constraints (voting_parallel_tree_learner.cpp:54-56)
-            local_tot = jnp.sum(hist[0], axis=0)  # (3,): identical per f
+            local_tot = jnp.sum(lhist[0], axis=0)  # (3,): identical per f
             local_hyper = hyper._replace(
                 min_data_in_leaf=hyper.min_data_in_leaf / params.num_machines,
                 min_sum_hessian_in_leaf=hyper.min_sum_hessian_in_leaf
                 / params.num_machines,
             )
             lg_f, _, _, _ = best_split_per_feature(
-                hist, local_tot[0], local_tot[1], local_tot[2],
+                lhist, local_tot[0], local_tot[1], local_tot[2],
                 meta, local_hyper, feature_mask, params.use_missing,
             )
             k2 = min(2 * params.top_k, f)
@@ -295,13 +318,23 @@ def grow_tree(
             voted_mask = jnp.zeros((f,), jnp.float32).at[voted].set(1.0)
             # 3. reduce only the voted features' histograms
             #    (CopyLocalHistogram + ReduceScatter, :196-350)
-            hist_voted = jax.lax.psum(hist * voted_mask[:, None, None], ax)
+            if quantized:
+                voted_i = voted_mask.astype(jnp.int32)
+                hist_voted = dequantize_hist(
+                    jax.lax.psum(hist * voted_i[:, None, None], ax), qscale
+                )
+            else:
+                hist_voted = jax.lax.psum(hist * voted_mask[:, None, None], ax)
             gain_f, thr_f, dbz_f, left_f = best_split_per_feature(
                 hist_voted, sg, sh, sc, meta, hyper,
                 feature_mask * voted_mask, params.use_missing,
             )
             res = finalize_split(gain_f, thr_f, dbz_f, left_f, sg, sh, sc, hyper)
         else:
+            if quantized:
+                # serial/feature: global int hist; data: already int-psum'd
+                # in _reduce_hist — either way one dequantization here
+                hist = dequantize_hist(hist, qscale)
             gain_f, thr_f, dbz_f, left_f = best_split_per_feature(
                 hist, sg, sh, sc, meta, hyper, feature_mask, params.use_missing
             )
@@ -317,12 +350,23 @@ def grow_tree(
         return res._replace(gain=jnp.where(depth_ok, res.gain, NEG_INF))
 
     # ---- root (BeforeTrain: LeafSplits::Init + root histogram)
-    tg = jnp.sum(grad * select)
-    th = jnp.sum(hess * select)
-    tc = jnp.sum(select)
-    tg, th, tc = global_sums(tg, th, tc)
+    if quantized:
+        # exact integer node totals: the int32 psum is order-invariant,
+        # so every shard count yields the identical root sums
+        s16 = select.astype(jnp.int16)
+        tgq = jnp.sum(grad * s16, dtype=jnp.int32)
+        thq = jnp.sum(hess * s16, dtype=jnp.int32)
+        tcq = jnp.sum(s16, dtype=jnp.int32)
+        tgq, thq, tcq = global_sums(tgq, thq, tcq)
+        root_sums = dequantize_sums(jnp.stack([tgq, thq, tcq]), qscale)
+        tc = root_sums[2]
+    else:
+        tg = jnp.sum(grad * select)
+        th = jnp.sum(hess * select)
+        tc = jnp.sum(select)
+        tg, th, tc = global_sums(tg, th, tc)
+        root_sums = jnp.stack([tg, th, tc])
     root_hist = hist_full(select)
-    root_sums = jnp.stack([tg, th, tc])
     root_res = find_best(root_hist, root_sums, jnp.array(True))
 
     zi = jnp.zeros((L,), jnp.int32)
@@ -333,7 +377,7 @@ def grow_tree(
         num_splits=jnp.int32(0),
         done=jnp.array(False),
         leaf_id=jnp.zeros((n,), jnp.int32),
-        pool=jnp.zeros((L, f, B, 3)).at[0].set(root_hist),
+        pool=jnp.zeros((L, f, B, 3), root_hist.dtype).at[0].set(root_hist),
         bs_gain=jnp.full((L,), NEG_INF),
         bs_feat=zi,
         bs_thr=zi,

@@ -389,6 +389,130 @@ def hist_segments(
     return hist.reshape(smax, num_features, num_bins, 3)
 
 
+# ======================================================================
+# quantized-training variant: exact int32 accumulation
+# ======================================================================
+def _hist_kernel_q(lohi_ref, p_ref, out_ref, acc_ref, *, nf, nb, rows, per,
+                   bits, fchunk):
+    """Integer twin of ``_hist_kernel`` for quantized training: the value
+    rows hold int16 levels stored as plain int32 words (no f32 bitcast),
+    the one-hot tile is int32, and the dot accumulates with
+    ``preferred_element_type=int32``.  No 3-term bf16 split — integer
+    accumulation is EXACT, so one term suffices and the (F*B, 3) output
+    needs no re-summation pass."""
+    j = pl.program_id(0)
+    g_row, h_row, sel_row = rows
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:, :] = jnp.zeros_like(acc_ref)
+
+    pos = jax.lax.broadcasted_iota(jnp.int32, (1, BLK), 1) + j * BLK
+    valid = ((pos >= lohi_ref[0]) & (pos < lohi_ref[1])).astype(jnp.int32)
+    sel = p_ref[sel_row : sel_row + 1, :] * valid  # int32 0/1
+    g = p_ref[g_row : g_row + 1, :] * sel
+    h = p_ref[h_row : h_row + 1, :] * sel
+    vals = jnp.concatenate([g, h, sel], axis=0)  # (3, BLK) int32
+
+    mask_v = (1 << bits) - 1
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (nb, BLK), 0)
+    for c0 in range(0, nf, fchunk):
+        c1 = min(c0 + fchunk, nf)
+        chunks = []
+        for f in range(c0, c1):
+            w, p = divmod(f, per)
+            byte = (p_ref[w : w + 1, :] >> (p * bits)) & mask_v
+            chunks.append((byte == iota_b).astype(jnp.int32))
+        oh = jnp.concatenate(chunks, axis=0)  # ((c1-c0)*nb, BLK) int32
+        acc_ref[c0 * nb : c1 * nb, :] += jax.lax.dot_general(
+            oh,
+            vals,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+
+    @pl.when(j == pl.num_programs(0) - 1)
+    def _flush():
+        out_ref[:, :] = acc_ref[:, :]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_features", "num_bins", "per", "bits", "rows", "interpret"),
+)
+def hist_segment_q(
+    p: jnp.ndarray,
+    lo: jnp.ndarray,
+    hi: jnp.ndarray,
+    num_features: int,
+    num_bins: int,
+    per: int = 4,
+    bits: int = 8,
+    rows: tuple = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """(F, B, 3) EXACT int32 histogram of columns [lo, hi) of a
+    quantized packed matrix (``pack_columns_q``) — the quantized-training
+    twin of :func:`hist_segment`.  The output is order-invariant by
+    construction (integer adds), which the bench ``kernel_ab`` leg pins
+    against the f32 kernel in interpret mode."""
+    c, s = p.shape
+    assert s % BLK == 0, f"segment length {s} not a multiple of {BLK}"
+    if rows is None:
+        w_words = -(-num_features // per)
+        rows = (w_words, w_words + 1, w_words + 2)
+    fb = num_features * num_bins
+    fchunk = tune_fchunk(num_features, num_bins)
+
+    lohi = jnp.stack([lo.astype(jnp.int32), hi.astype(jnp.int32)])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(s // BLK,),
+        in_specs=[
+            pl.BlockSpec((c, BLK), lambda j, lohi: (0, j), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (fb, 3), lambda j, lohi: (0, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[pltpu.VMEM((fb, 3), jnp.int32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _hist_kernel_q,
+            nf=num_features,
+            nb=num_bins,
+            rows=rows,
+            per=per,
+            bits=bits,
+            fchunk=fchunk,
+        ),
+        out_shape=jax.ShapeDtypeStruct((fb, 3), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(lohi, p)
+    return out.reshape(num_features, num_bins, 3)
+
+
+def pack_columns_q(bins, qgrad, qhess, select, per: int = 4, bits: int = 8):
+    """Quantized twin of :func:`pack_columns`: the value rows carry the
+    int16 levels (and the 0/1 select) widened to plain int32 words —
+    integer identity, no bitcasting."""
+    n, f = bins.shape
+    w = -(-f // per)
+    pad_f = w * per - f
+    bb = jnp.pad(bins.astype(jnp.int32), ((0, 0), (0, pad_f)))
+    bb = bb.reshape(n, w, per)
+    shifts = (jnp.arange(per) * bits).astype(jnp.int32)
+    words = jnp.sum(bb << shifts[None, None, :], axis=2, dtype=jnp.int32)
+    rows = [
+        words.T,
+        qgrad.astype(jnp.int32)[None, :],
+        qhess.astype(jnp.int32)[None, :],
+        select.astype(jnp.int32)[None, :],
+    ]
+    return jnp.concatenate(rows, axis=0)
+
+
 def pack_columns(
     bins, grad, hess, select, row_id=None, per: int = 4, bits: int = 8
 ):

@@ -104,7 +104,15 @@ def split_chunk(leaf_id, hist_l, hist_r, n_left, bins_chunk, grad, hess,
 def root_totals(grad, hess, select):
     """Root leaf sums — the same full-N reductions as ``grow_tree``'s
     ``LeafSplits::Init`` (the N-vectors stay device-resident out of
-    core, so these are not chunked)."""
+    core, so these are not chunked).
+
+    Integer (quantized-training) gradients return exact (3,) int32
+    totals; the trainer dequantizes them host-side."""
+    if jnp.issubdtype(grad.dtype, jnp.integer):
+        s16 = select.astype(jnp.int16)
+        return jnp.stack([jnp.sum(grad * s16, dtype=jnp.int32),
+                          jnp.sum(hess * s16, dtype=jnp.int32),
+                          jnp.sum(s16, dtype=jnp.int32)])
     tg = jnp.sum(grad * select)
     th = jnp.sum(hess * select)
     tc = jnp.sum(select)

@@ -15,9 +15,15 @@ communicators implement that surface:
   exact and identical to what NetComm would send, without subprocesses.
 
 Both keep an always-on ``ledger`` mapping purpose -> bytes sent by this
-rank (``hist`` / ``best_split`` / ``vote`` / ``elect``), independent of
-whether tracing is enabled — the bench comms section and the per-iter
-``net_bytes`` report field read it directly.
+rank (``hist`` / ``best_split`` / ``vote`` / ``elect``, plus ``hist_q``
+for the quantized-training int16 histogram wire and its scale/root-sum
+side channels), independent of whether tracing is enabled — the bench
+comms section and the per-iter ``net_bytes`` report field read it
+directly.  Under ``quantized_training`` the per-node histogram payload
+moves from f32x3 (``hist``, F*B*12 bytes) to int16x2 (``hist_q``,
+F*B*4 bytes — the count plane is derived at the receiver), a fixed 3x
+wire reduction; the report CLI surfaces the measured ratio per
+iteration.
 """
 
 from __future__ import annotations

@@ -41,7 +41,22 @@ invariant).
 
 Purpose tags on every exchange (``net.bytes{purpose=...}``):
 ``hist`` histogram payloads, ``best_split`` split records / partition
-bitmaps / node counts, ``vote`` ballots, ``elect`` election results.
+bitmaps / node counts, ``vote`` ballots, ``elect`` election results,
+``hist_q`` quantized-training payloads (scale maxima, int root totals
+and the int16-packed 2-plane histograms of ops/qhist.py).
+
+Quantized training (``params.quantized``, data/voting modes): grad/hess
+are stochastically rounded to int16 levels under a per-iteration global
+scale (the scale maxima are the first ``hist_q`` exchange of each
+tree), histograms accumulate in exact int32, and every histogram
+payload ships as the 2-plane int16 ``hist_q`` wire — F*B*4 bytes
+against the f32x3 wire's F*B*12.  The receiver derives the count plane
+from the hessian plane and the node totals (the reference's cnt_factor
+trick), merges ranks in exact integer arithmetic, and dequantizes once
+before the split scan — so the merged histogram, and therefore the
+tree, is IDENTICAL for any rank count and any row order.  Feature mode
+ignores the flag: its rows are replicated and its exchanges are
+28-byte records, so there is no histogram wire to compress.
 """
 
 from __future__ import annotations
@@ -54,6 +69,7 @@ import jax
 import numpy as np
 
 from ..obs import tracer
+from ..ops import qhist
 from ..ops.grow import GrowParams, GrowResult
 from ..ops.histogram import build_histogram
 from ..ops.split import (
@@ -72,6 +88,10 @@ from .comm import Comm
 _REC = struct.Struct("<fiiifff")
 _CNT = struct.Struct("<ii")
 _SUMS = struct.Struct("<fff")
+# quantized-training exchanges: per-rank (max|g|, max|h|) for the global
+# scale, and exact int64 quantized root totals (sum_qg, sum_qh, count)
+_QMAX = struct.Struct("<ff")
+_QSUMS = struct.Struct("<qqq")
 
 
 # ---------------------------------------------------------------------
@@ -93,6 +113,18 @@ def _root_sums(grad, hess, select):
 
     return (jnp.sum(grad * select), jnp.sum(hess * select),
             jnp.sum(select))
+
+
+@jax.jit
+def _root_sums_q(qgrad, qhess, select):
+    """Exact int32 quantized node totals — associative, so any rank
+    count / row order sums to the identical integers."""
+    import jax.numpy as jnp
+
+    s16 = select.astype(jnp.int16)
+    return (jnp.sum(qgrad * s16, dtype=jnp.int32),
+            jnp.sum(qhess * s16, dtype=jnp.int32),
+            jnp.sum(s16, dtype=jnp.int32))
 
 
 @functools.partial(jax.jit, static_argnames=("use_missing",))
@@ -148,12 +180,21 @@ class HostParallelLearner:
     learner; inputs are this rank's shard (rows for data/voting, the
     full replicated matrix for feature mode)."""
 
+    # gbdt.py hands us f32 gradients even under quantized_training: the
+    # quantization scale must be a max over ALL ranks' rows, so the
+    # allgather of local maxima happens inside _grow, not in the driver
+    quantizes_internally = True
+
     def __init__(self, mode: str, comm: Comm, params: GrowParams):
         if mode not in ("data", "feature", "voting"):
             raise ValueError(f"unknown host learner mode {mode!r}")
         self.mode = mode
         self.comm = comm
         self.params = params
+        # quantized training runs only in the histogram-exchanging modes
+        self.quant = bool(params.quantized) and mode in ("data", "voting")
+        self._qiter = -1  # per-grow stochastic-rounding key counter
+        self._qscales = None  # (2,) np.float32 scales of the current tree
 
     # -- helpers ------------------------------------------------------
 
@@ -171,6 +212,15 @@ class HostParallelLearner:
         tot = parts[0].copy()
         for p in parts[1:]:
             tot = tot + p
+        return tot
+
+    def _merge_q(self, blobs: List[bytes], f: int, b: int) -> np.ndarray:
+        """Exact integer merge of 2-plane ``hist_q`` payloads — int64
+        adds are associative, so the merged planes are independent of
+        rank count and merge order (the quantized determinism anchor)."""
+        tot = qhist.unpack_hist_q(blobs[0], f, b).astype(np.int64)
+        for blob in blobs[1:]:
+            tot = tot + qhist.unpack_hist_q(blob, f, b)
         return tot
 
     # -- per-node best split, one exchange pattern per mode -----------
@@ -207,8 +257,18 @@ class HostParallelLearner:
         else:
             if self.mode == "voting":
                 ghist, vmask = self._vote_and_merge(jnp, hist, meta, hyper,
-                                                    feature_mask, f)
+                                                    feature_mask, f, sc)
                 fmask = feature_mask * jnp.asarray(vmask)
+            elif self.quant:
+                # 2-plane int16 wire (F*B*4 bytes vs the f32 wire's
+                # F*B*12), exact integer merge, count plane derived from
+                # the hessian plane + node totals (ops/qhist.py)
+                blob = qhist.pack_hist_q(np.asarray(hist)[..., :2])
+                blobs = self.comm.allgather(blob, "hist_q")
+                merged = self._merge_q(blobs, f, p.num_bins)
+                ghist = qhist.assemble_hist(merged, self._qscales,
+                                            float(sc))
+                fmask = feature_mask
             else:
                 blobs = self.comm.allgather(
                     np.asarray(hist, np.float32).tobytes(), "hist")
@@ -227,7 +287,8 @@ class HostParallelLearner:
             gain = NEG_INF
         return np.float32(gain), feat, thr, dbz, left
 
-    def _vote_and_merge(self, jnp, hist, meta, hyper, feature_mask, f):
+    def _vote_and_merge(self, jnp, hist, meta, hyper, feature_mask, f,
+                        node_cnt=None):
         """PV-Tree exchange: ballot -> election -> elected-column merge.
         Returns (global (F, B, 3) hist with non-elected columns zero,
         elected 0/1 mask)."""
@@ -235,6 +296,12 @@ class HostParallelLearner:
         nproc = self.comm.nproc
         k = max(min(p.top_k, f), 1)
         k2 = min(2 * k, f)
+        if self.quant:
+            # ballots are cast from the dequantized LOCAL hist (its
+            # count plane is still an exact device integer); only the
+            # elected columns ship, as 2-plane int16 hist_q payloads
+            qhist_local = hist
+            hist = qhist.dequantize_hist(hist, jnp.asarray(self._qscales))
         # local proposals under /nproc-relaxed constraints
         # (voting_parallel_tree_learner.cpp:54-56)
         lt = _local_leaf_tot(hist)
@@ -258,9 +325,19 @@ class HostParallelLearner:
             raise RuntimeError(
                 "voting-parallel election disagreed across ranks — "
                 "non-deterministic local gains?")
-        sub = np.ascontiguousarray(np.asarray(hist, np.float32)[elected])
-        parts = self.comm.allgather(sub.tobytes(), "hist")
-        merged_sub = self._merge_f32(parts, (k2, p.num_bins, 3))
+        if self.quant:
+            sub_q = np.asarray(qhist_local)[elected][..., :2]
+            parts = self.comm.allgather(qhist.pack_hist_q(sub_q), "hist_q")
+            merged_q = self._merge_q(parts, k2, p.num_bins)
+            # every row lands in one bin of ANY feature, so the first
+            # elected column's hessian plane sums to the node total the
+            # cnt_factor derivation needs
+            merged_sub = qhist.assemble_hist(merged_q, self._qscales,
+                                             float(node_cnt))
+        else:
+            sub = np.ascontiguousarray(np.asarray(hist, np.float32)[elected])
+            parts = self.comm.allgather(sub.tobytes(), "hist")
+            merged_sub = self._merge_f32(parts, (k2, p.num_bins, 3))
         ghist = np.zeros((f, p.num_bins, 3), np.float32)
         ghist[elected] = merged_sub
         vmask = np.zeros((f,), np.float32)
@@ -292,6 +369,27 @@ class HostParallelLearner:
             per, lo, hi = f, 0, f
             hbins, hmeta, hmask = bins, meta, feature_mask
 
+        if self.quant:
+            # ---- per-tree quantization: global scales from allgathered
+            # local maxima (every rank derives the identical f32 scale),
+            # then value-keyed stochastic rounding — a row quantizes the
+            # same way whichever rank holds it, so the merged integer
+            # histogram is invariant under rank count and row order.
+            self._qiter += 1
+            seed = (int(p.quant_seed) * 2654435761
+                    + self._qiter * 97 + 1) & 0xFFFFFFFF
+            mx = np.asarray(qhist.local_absmax(grad, hess, select),
+                            np.float32)
+            blobs = self.comm.allgather(
+                _QMAX.pack(float(mx[0]), float(mx[1])), "hist_q")
+            maxima = [_QMAX.unpack(b) for b in blobs]
+            self._qscales = qhist.scales_from_max(
+                max(m[0] for m in maxima), max(m[1] for m in maxima),
+                p.quant_bits)
+            grad, hess = qhist.quantize_rows(
+                grad, hess, jnp.asarray(self._qscales), np.uint32(seed),
+                p.quant_bits)
+
         def node_hist(leaf_id, target):
             if hbins is None:
                 return None
@@ -299,17 +397,32 @@ class HostParallelLearner:
                               np.int32(target), B, p.row_block)
 
         # ---- root totals (LeafSplits::Init)
-        tg, th, tc = _root_sums(grad, hess, select)
-        if rowed:
+        if self.quant:
+            # exact integer totals: int64-packed exchange, Python-int
+            # rank sum, one dequantization on the host
+            qg, qh, qc = _root_sums_q(grad, hess, select)
             blobs = self.comm.allgather(
-                _SUMS.pack(float(tg), float(th), float(tc)), "best_split")
-            vals = [np.array(_SUMS.unpack(b), np.float32) for b in blobs]
-            tot = vals[0].copy()
-            for v in vals[1:]:
-                tot = tot + v
-            tg, th, tc = tot[0], tot[1], tot[2]
+                _QSUMS.pack(int(qg), int(qh), int(qc)), "hist_q")
+            sums_i = [_QSUMS.unpack(b) for b in blobs]
+            tot_g = sum(s[0] for s in sums_i)
+            tot_h = sum(s[1] for s in sums_i)
+            tot_c = sum(s[2] for s in sums_i)
+            tg = np.float32(np.float32(tot_g) * self._qscales[0])
+            th = np.float32(np.float32(tot_h) * self._qscales[1])
+            tc = np.float32(tot_c)
         else:
-            tg, th, tc = np.float32(tg), np.float32(th), np.float32(tc)
+            tg, th, tc = _root_sums(grad, hess, select)
+            if rowed:
+                blobs = self.comm.allgather(
+                    _SUMS.pack(float(tg), float(th), float(tc)),
+                    "best_split")
+                vals = [np.array(_SUMS.unpack(b), np.float32) for b in blobs]
+                tot = vals[0].copy()
+                for v in vals[1:]:
+                    tot = tot + v
+                tg, th, tc = tot[0], tot[1], tot[2]
+            else:
+                tg, th, tc = np.float32(tg), np.float32(th), np.float32(tc)
 
         leaf_id = jnp.zeros((n,), jnp.int32)
         root_hist = node_hist(leaf_id, 0)

@@ -67,7 +67,7 @@ class ShardedLearner:
         feature_sharded = mode == "feature"
         d = self.d
 
-        def body(bins, grad, hess, select, fmask, meta, hyper):
+        def body(bins, grad, hess, select, fmask, meta, hyper, qscale=None):
             if feature_sharded:
                 # contiguous per-shard feature ownership
                 # (balanced assignment, feature_parallel_tree_learner.cpp:31-50)
@@ -75,7 +75,8 @@ class ShardedLearner:
                 per = -(-f // d)
                 own = (jnp.arange(f) // per) == jax.lax.axis_index("data")
                 fmask = fmask * own.astype(fmask.dtype)
-            return grow_tree(bins, grad, hess, select, fmask, meta, hyper, self.params)
+            return grow_tree(bins, grad, hess, select, fmask, meta, hyper,
+                             self.params, qscale)
 
         rowspec = P("data") if row_sharded else P()
         in_specs = (
@@ -87,6 +88,10 @@ class ShardedLearner:
             P(),  # meta
             P(),  # hyper
         )
+        if self.params.quantized:
+            # quantized training: the (2,) global dequantization scales
+            # ride along replicated (computed once per iteration upstream)
+            in_specs = in_specs + (P(),)
         out_specs = GrowResult(
             num_splits=P(),
             leaf_id=P("data") if row_sharded else P(),
@@ -111,7 +116,8 @@ class ShardedLearner:
         self._global_bins = None  # cached assembled bins + gmax (multi-process)
 
     # ------------------------------------------------------------------
-    def grow(self, bins, grad, hess, select, feature_mask, meta, hyper) -> GrowResult:
+    def grow(self, bins, grad, hess, select, feature_mask, meta, hyper,
+             qscale=None) -> GrowResult:
         """Grow one tree.  In a multi-process runtime each process passes
         its OWN row block (the reference's pre_partition=true contract,
         config.h:116) with equal per-process row counts; arrays are
@@ -163,7 +169,12 @@ class ShardedLearner:
                     jax.tree_util.tree_map(lambda x: replicated_array(x, self.mesh), hyper),
                 )
             meta, hyper = self._rep_consts
-        gr = self._fn(bins, grad, hess, select, feature_mask, meta, hyper)
+            if self.params.quantized and qscale is not None:
+                qscale = replicated_array(qscale, self.mesh)
+        args = (bins, grad, hess, select, feature_mask, meta, hyper)
+        if self.params.quantized:
+            args = args + (qscale,)
+        gr = self._fn(*args)
         if multi and self._row_sharded:
             # leaf_id comes back row-sharded globally; hand the caller its
             # process-local rows (matching the rows it passed in)

@@ -357,7 +357,10 @@ _KV_FRAME = b"LT1\x00"
 # chunked KV payloads.  The coordination-service KV store is built for
 # small config values; multi-MB blobs (elected-histogram allgathers on
 # the XLA:CPU transport, wide-matrix find-bin states) are split across
-# framed continuation keys with a per-chunk CRC.  The head value either
+# framed continuation keys with a per-chunk CRC.  (Quantized training,
+# purpose "hist_q", shrinks the histogram blobs 3x — int16 (g,h) planes
+# instead of f32 (g,h,cnt) — so wide exchanges often fit in a single
+# head value and skip the continuation machinery.)  The head value either
 # carries the whole payload (_KV_RAW) or a descriptor + the first chunk
 # (_KV_CHUNKED); continuation chunks are written BEFORE the head, so a
 # reader that sees the head never waits on a missing chunk — no extra

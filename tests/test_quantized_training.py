@@ -1,0 +1,413 @@
+"""Quantized-gradient training (ops/qhist.py, quantized_training=true).
+
+Contracts pinned here:
+
+  - flag OFF is the default and leaves the f32 path byte-identical
+    (engine level, and the 2-rank data-parallel world still exchanges
+    the f32 "hist" wire);
+  - stochastic rounding is unbiased across iteration seeds and exact on
+    grid points;
+  - the int accumulation path is row-order invariant and rank-count
+    invariant (integer adds are associative), where the f32 path is
+    neither guaranteed nor tested to be;
+  - quantized split gains sit inside the exported analytic drift bound
+    at max_bin=255;
+  - the "hist_q" wire is exactly F*B*4 bytes (int16), falls back to a
+    length-discriminated int32 format on overflow, and round-trips.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.ops import qhist  # noqa: E402
+from lightgbm_tpu.ops.grow import GrowParams, grow_tree  # noqa: E402
+from lightgbm_tpu.ops.histogram import build_histogram  # noqa: E402
+from lightgbm_tpu.ops.split import (  # noqa: E402
+    FeatureMeta,
+    SplitHyper,
+    best_split_per_feature,
+)
+from lightgbm_tpu.parallel import HostParallelLearner, LocalGroup  # noqa: E402
+
+
+def _meta(f, B):
+    return FeatureMeta(jnp.full((f,), B, jnp.int32),
+                       jnp.zeros((f,), jnp.int32),
+                       jnp.zeros((f,), bool))
+
+
+def _hyper(min_data=20.0):
+    return SplitHyper(jnp.float32(0.0), jnp.float32(0.1),
+                      jnp.float32(min_data), jnp.float32(1e-3),
+                      jnp.float32(0.0))
+
+
+def _run_group(mode, params, shards, meta, hyper, fmask):
+    """Grow one tree on every simulated rank; returns (results, ledgers)."""
+    nproc = len(shards)
+    grp = LocalGroup(nproc)
+    out = [None] * nproc
+    errs = []
+
+    def worker(r, comm):
+        try:
+            b, g, h = shards[r]
+            n = b.shape[0]
+            learner = HostParallelLearner(mode, comm, params)
+            gr = learner.grow(
+                jnp.asarray(b), jnp.asarray(g), jnp.asarray(h),
+                jnp.ones((n,), jnp.float32), fmask, meta, hyper)
+            out[r] = (jax.tree_util.tree_map(np.asarray, gr), comm.ledger)
+        except BaseException as e:  # surface worker failures to pytest
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=worker, args=(r, c))
+          for r, c in enumerate(grp.comms())]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errs:
+        raise errs[0][1]
+    return out
+
+
+def _assert_same_tree(a, b, skip=()):
+    for name, x, y in zip(a._fields, a, b):
+        if name in skip:
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg=f"field {name}")
+
+
+def _quantize(grad, hess, seed=3, bits=qhist.QUANT_BITS):
+    n = len(grad)
+    mx = np.asarray(qhist.local_absmax(
+        jnp.asarray(grad), jnp.asarray(hess), jnp.ones((n,), jnp.float32)))
+    scales = qhist.scales_from_max(mx[0], mx[1], bits)
+    qg, qh = qhist.quantize_rows(
+        jnp.asarray(grad), jnp.asarray(hess), jnp.asarray(scales),
+        np.uint32(seed), bits)
+    return qg, qh, scales
+
+
+@pytest.fixture(scope="module")
+def small():
+    rng = np.random.default_rng(11)
+    n, f, B = 2000, 23, 16
+    bins = rng.integers(0, B, size=(n, f)).astype(np.uint8)
+    grad = rng.normal(size=n).astype(np.float32)
+    hess = (0.5 + rng.random(n)).astype(np.float32)
+    return n, f, B, bins, grad, hess
+
+
+@pytest.fixture(scope="module")
+def trainable():
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(600, 10)).astype(np.float32)
+    y = (X[:, 0] + 0.4 * X[:, 1] ** 2
+         + rng.normal(scale=0.1, size=600) > 0.3).astype(np.float32)
+    return X, y
+
+
+# ----------------------------------------------------------------------
+# flag OFF: the default path is untouched
+# ----------------------------------------------------------------------
+class TestFlagOffParity:
+    def _train(self, X, y, extra):
+        p = dict(objective="binary", num_leaves=15, learning_rate=0.1,
+                 min_data_in_leaf=5, verbose=-1, seed=7)
+        p.update(extra)
+        bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=5)
+        return bst.predict(X)
+
+    def test_engine_default_is_off_and_identical(self, trainable):
+        X, y = trainable
+        base = self._train(X, y, {})
+        off = self._train(X, y, {"quantized_training": False})
+        np.testing.assert_array_equal(base, off)
+
+    def test_use_quantized_grad_alias(self, trainable):
+        X, y = trainable
+        a = self._train(X, y, {"use_quantized_grad": True})
+        b = self._train(X, y, {"quantized_training": True})
+        np.testing.assert_array_equal(a, b)
+
+    def test_data_world_flag_off_keeps_f32_wire(self, small):
+        n, f, B, bins, grad, hess = small
+        params = GrowParams(num_leaves=7, num_bins=B)
+        cut = n // 2
+        shards = [(bins[:cut], grad[:cut], hess[:cut]),
+                  (bins[cut:], grad[cut:], hess[cut:])]
+        meta, hyper = _meta(f, B), _hyper()
+        fmask = jnp.ones((f,), jnp.float32)
+        res = _run_group("data", params, shards, meta, hyper, fmask)
+        ledger = res[0][1]
+        assert ledger.get("hist", 0) > 0
+        assert "hist_q" not in ledger
+        # the flag-off world is deterministic: a repeat run is
+        # byte-identical
+        res2 = _run_group("data", params, shards, meta, hyper, fmask)
+        for (a, _), (b, _) in zip(res, res2):
+            _assert_same_tree(a, b)
+
+
+# ----------------------------------------------------------------------
+# stochastic rounding
+# ----------------------------------------------------------------------
+class TestStochasticRounding:
+    def test_unbiased_across_seeds(self):
+        scales = jnp.asarray(np.asarray([0.01, 0.02], np.float32))
+        g = jnp.asarray(np.asarray([0.123], np.float32))  # g/s = 12.3
+        h = jnp.asarray(np.asarray([0.031], np.float32))  # h/s = 1.55
+        qs_g, qs_h = [], []
+        for seed in range(400):
+            qg, qh = qhist.quantize_rows(g, h, scales, np.uint32(seed))
+            qs_g.append(int(qg[0]))
+            qs_h.append(int(qh[0]))
+        # floor(x/s + u) takes only the two bracketing integers, with
+        # P(upper) = frac(x/s): the seed-mean converges to x/s
+        assert set(qs_g) <= {12, 13}
+        assert abs(np.mean(qs_g) - 12.3) < 0.11  # ~4 sigma at 400 draws
+        assert abs(np.mean(qs_h) - 1.55) < 0.11
+
+    def test_exact_on_grid_points(self):
+        scales = jnp.asarray(np.asarray([0.25, 0.5], np.float32))
+        g = jnp.asarray(np.asarray([2.5, -1.25, 0.0], np.float32))
+        for seed in (0, 1, 99):
+            qg, _ = qhist.quantize_rows(
+                g, jnp.zeros(3, jnp.float32), scales, np.uint32(seed))
+            np.testing.assert_array_equal(np.asarray(qg), [10, -5, 0])
+
+    def test_value_keyed_row_order_invariance(self, small):
+        n, f, B, bins, grad, hess = small
+        qg, qh, _ = _quantize(grad, hess, seed=17)
+        perm = np.random.default_rng(0).permutation(n)
+        qg_p, qh_p, _ = _quantize(grad[perm], hess[perm], seed=17)
+        np.testing.assert_array_equal(np.asarray(qg)[perm], np.asarray(qg_p))
+        np.testing.assert_array_equal(np.asarray(qh)[perm], np.asarray(qh_p))
+
+
+# ----------------------------------------------------------------------
+# int accumulation: exactness and determinism
+# ----------------------------------------------------------------------
+class TestIntHistogramDeterminism:
+    def test_hist_row_order_invariant(self, small):
+        n, f, B, bins, grad, hess = small
+        qg, qh, _ = _quantize(grad, hess)
+        sel = jnp.ones((n,), jnp.float32)
+        ref = np.asarray(build_histogram(jnp.asarray(bins), qg, qh, sel, B))
+        assert ref.dtype == np.int32
+        for s in (1, 2):
+            perm = np.random.default_rng(s).permutation(n)
+            got = np.asarray(build_histogram(
+                jnp.asarray(bins[perm]), qg[perm], qh[perm], sel, B))
+            np.testing.assert_array_equal(ref, got)
+
+    def test_serial_tree_shuffle_invariant(self, small):
+        n, f, B, bins, grad, hess = small
+        params = GrowParams(num_leaves=15, num_bins=B, quantized=True)
+        meta, hyper = _meta(f, B), _hyper()
+        fmask = jnp.ones((f,), jnp.float32)
+        sel = jnp.ones((n,), jnp.float32)
+        qg, qh, scales = _quantize(grad, hess)
+        qs = jnp.asarray(scales)
+        ref = jax.tree_util.tree_map(np.asarray, grow_tree(
+            jnp.asarray(bins), qg, qh, sel, fmask, meta, hyper, params,
+            qscale=qs))
+        assert int(ref.num_splits) > 3
+        perm = np.random.default_rng(2).permutation(n)
+        got = jax.tree_util.tree_map(np.asarray, grow_tree(
+            jnp.asarray(bins[perm]), qg[perm], qh[perm], sel, fmask, meta,
+            hyper, params, qscale=qs))
+        # leaf_id is a per-row partition — everything else must be
+        # byte-identical under the permutation
+        _assert_same_tree(ref, got, skip=("leaf_id",))
+        np.testing.assert_array_equal(ref.leaf_id[perm], got.leaf_id)
+
+    @pytest.mark.parametrize("nprocs", [(2, 4)])
+    def test_data_world_rank_count_invariant(self, small, nprocs):
+        n, f, B, bins, grad, hess = small
+        params = GrowParams(num_leaves=7, num_bins=B, quantized=True)
+        meta, hyper = _meta(f, B), _hyper()
+        fmask = jnp.ones((f,), jnp.float32)
+        trees = []
+        for R in nprocs:
+            cuts = np.linspace(0, n, R + 1).astype(int)
+            shards = [(bins[a:b], grad[a:b], hess[a:b])
+                      for a, b in zip(cuts[:-1], cuts[1:])]
+            res = _run_group("data", params, shards, meta, hyper, fmask)
+            ledger = res[0][1]
+            assert ledger.get("hist_q", 0) > 0 and "hist" not in ledger
+            trees.append(res[0][0])
+        _assert_same_tree(trees[0], trees[1], skip=("leaf_id",))
+
+    def test_voting_full_vote_equals_data(self, small):
+        n, f, B, bins, grad, hess = small
+        # top_k = f: every feature is elected, so PV-Tree must reduce to
+        # the exact data-parallel tree — in integers, byte-identically
+        meta, hyper = _meta(f, B), _hyper()
+        fmask = jnp.ones((f,), jnp.float32)
+        cut = n // 2
+        shards = [(bins[:cut], grad[:cut], hess[:cut]),
+                  (bins[cut:], grad[cut:], hess[cut:])]
+        pd = GrowParams(num_leaves=7, num_bins=B, quantized=True)
+        pv = GrowParams(num_leaves=7, num_bins=B, quantized=True, top_k=f)
+        rd = _run_group("data", pd, shards, meta, hyper, fmask)
+        rv = _run_group("voting", pv, shards, meta, hyper, fmask)
+        for (a, _), (b, _) in zip(rd, rv):
+            _assert_same_tree(a, b)
+
+
+# ----------------------------------------------------------------------
+# drift bound at max_bin=255
+# ----------------------------------------------------------------------
+class TestDriftBound:
+    def test_gains_within_bound_max_bin_255(self):
+        rng = np.random.default_rng(3)
+        n, f, B = 4096, 8, 256
+        bins = rng.integers(0, B, size=(n, f)).astype(np.uint8)
+        grad = rng.normal(size=n).astype(np.float32)
+        hess = (0.5 + rng.random(n)).astype(np.float32)
+        sel = jnp.ones((n,), jnp.float32)
+        meta, hyper = _meta(f, B), _hyper()
+        fmask = jnp.ones((f,), jnp.float32)
+        qg, qh, scales = _quantize(grad, hess)
+
+        hist_f = build_histogram(jnp.asarray(bins), jnp.asarray(grad),
+                                 jnp.asarray(hess), sel, B)
+        hist_q = qhist.dequantize_hist(
+            build_histogram(jnp.asarray(bins), qg, qh, sel, B),
+            jnp.asarray(scales))
+        sums_f = (float(np.sum(grad)), float(np.sum(hess)), float(n))
+        sums_q = np.asarray(qhist.dequantize_sums(
+            jnp.stack([jnp.sum(qg, dtype=jnp.int32),
+                       jnp.sum(qh, dtype=jnp.int32),
+                       jnp.int32(n)]), jnp.asarray(scales)))
+        gains_f = np.asarray(best_split_per_feature(
+            hist_f, jnp.float32(sums_f[0]), jnp.float32(sums_f[1]),
+            jnp.float32(sums_f[2]), meta, hyper, fmask, True)[0])
+        gains_q = np.asarray(best_split_per_feature(
+            hist_q, jnp.float32(sums_q[0]), jnp.float32(sums_q[1]),
+            jnp.float32(sums_q[2]), meta, hyper, fmask, True)[0])
+        bound = qhist.quant_drift_bound(
+            scales[0], scales[1], n, lambda_l2=0.1, min_hessian=1e-3)
+        assert np.isfinite(bound) and bound > 0
+        valid = np.isfinite(gains_f) & np.isfinite(gains_q)
+        assert valid.any()
+        assert float(np.abs(gains_f[valid] - gains_q[valid]).max()) <= bound
+
+    def test_bound_shrinks_with_bits(self):
+        # more bits -> smaller scale -> tighter bound (same maxima)
+        bounds = [qhist.quant_drift_bound(
+            1.0 / qhist.qmax_for(b), 1.0 / qhist.qmax_for(b), 1000,
+            lambda_l2=2000.0, bits=b) for b in (3, 5, 8)]
+        assert bounds[0] > bounds[1] > bounds[2] > 0
+
+
+# ----------------------------------------------------------------------
+# hist_q wire format
+# ----------------------------------------------------------------------
+class TestWireFormat:
+    def test_int16_roundtrip_and_exact_size(self):
+        rng = np.random.default_rng(1)
+        F, B = 23, 16
+        hist2 = rng.integers(-3000, 3000, size=(F, B, 2)).astype(np.int32)
+        blob = qhist.pack_hist_q(hist2)
+        assert len(blob) == qhist.wire_bytes_q(F, B) == F * B * 4
+        assert qhist.wire_bytes_f32(F, B) == 3 * qhist.wire_bytes_q(F, B)
+        np.testing.assert_array_equal(qhist.unpack_hist_q(blob, F, B), hist2)
+
+    def test_int32_overflow_fallback(self):
+        F, B = 5, 8
+        hist2 = np.zeros((F, B, 2), np.int32)
+        hist2[2, 3, 0] = 40_000  # exceeds int16
+        blob = qhist.pack_hist_q(hist2)
+        assert len(blob) == F * B * 8
+        np.testing.assert_array_equal(qhist.unpack_hist_q(blob, F, B), hist2)
+
+    def test_bad_length_raises(self):
+        with pytest.raises(ValueError, match="neither"):
+            qhist.unpack_hist_q(b"\x00" * 10, 5, 8)
+
+    def test_count_plane_derivation(self, small):
+        n, f, B, bins, grad, hess = small
+        qg, qh, scales = _quantize(grad, hess)
+        sel = jnp.ones((n,), jnp.float32)
+        hist = np.asarray(build_histogram(jnp.asarray(bins), qg, qh, sel, B))
+        asm = qhist.assemble_hist(hist[..., :2], scales, float(n))
+        # derived counts track the exact counts (cnt_factor trick):
+        # each bin rounds by < 0.5, so a feature's B bins sum to the
+        # node count within B/2
+        assert float(np.abs(asm[..., 2].sum(axis=1) - n).max()) <= B / 2
+        assert float(np.abs(asm[..., 2] - hist[..., 2]).max()) <= 32.0
+
+
+# ----------------------------------------------------------------------
+# engine-level quantized runs
+# ----------------------------------------------------------------------
+class TestEngineQuantized:
+    def _train(self, X, y, extra, rounds=5):
+        p = dict(objective="binary", num_leaves=15, learning_rate=0.1,
+                 min_data_in_leaf=5, verbose=-1, seed=7,
+                 quantized_training=True)
+        p.update(extra)
+        bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=rounds)
+        return bst.predict(X)
+
+    def test_deterministic_across_runs(self, trainable):
+        X, y = trainable
+        a = self._train(X, y, {})
+        b = self._train(X, y, {})
+        np.testing.assert_array_equal(a, b)
+        assert np.isfinite(a).all()
+
+    def test_out_of_core_matches_in_memory(self, trainable):
+        # integer accumulation makes the chunk grid irrelevant: the
+        # streamed trainer must reproduce the in-memory quantized trees
+        # byte for byte
+        X, y = trainable
+        a = self._train(X, y, {})
+        b = self._train(X, y, {"out_of_core": True})
+        np.testing.assert_array_equal(a, b)
+
+    def test_bits_validation(self, trainable):
+        X, y = trainable
+        with pytest.raises(lgb.LightGBMError, match="quantized_grad_bits"):
+            self._train(X, y, {"quantized_grad_bits": 99})
+
+    def test_learns_signal(self, trainable):
+        X, y = trainable
+        pred = self._train(X, y, {}, rounds=20)
+        acc = float(np.mean((pred > 0.5) == (y > 0.5)))
+        assert acc > 0.85
+
+
+# ----------------------------------------------------------------------
+# report plumbing
+# ----------------------------------------------------------------------
+class TestReportQuantizedWire:
+    def test_summary_and_ratio(self):
+        from lightgbm_tpu.obs.report import (
+            net_bytes_by_purpose,
+            quantized_wire_summary,
+        )
+
+        recs = [{"ev": "counter", "name": "net.bytes", "value": 400.0,
+                 "purpose": "hist_q"},
+                {"ev": "counter", "name": "net.bytes", "value": 100.0,
+                 "purpose": "best_split"}]
+        pb = net_bytes_by_purpose(recs)
+        assert pb == {"hist_q": 400.0, "best_split": 100.0}
+        qw = quantized_wire_summary(pb, iters=2)
+        assert qw["ratio"] == 3.0
+        assert qw["hist_q_bytes_per_iter"] == 200.0
+        # unquantized runs report ratio 1.0; no histogram purpose -> None
+        assert quantized_wire_summary({"hist": 600.0}, 1)["ratio"] == 1.0
+        assert quantized_wire_summary({"vote": 5.0}, 1) is None
