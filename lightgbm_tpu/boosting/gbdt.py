@@ -140,6 +140,32 @@ class GBDT:
                     "with %s; training in-memory", ooc_why, unsupported)
                 ooc_on = False
 
+        # quantized training accumulates n*QMAX in int32 (root totals and
+        # psum'd histogram bins, ops/grow.py) — past the headroom it would
+        # wrap silently and grow wrong trees, so decline up front
+        if config.quantized_training:
+            from ..ops import qhist as _qhist
+
+            n_rows = self.num_data
+            if config.tree_learner.lower() in ("data", "feature", "voting"):
+                import jax as _jax
+
+                if _jax.process_count() > 1:
+                    # the data-parallel psum sums GLOBAL rows into a bin
+                    from jax.experimental import multihost_utils
+
+                    n_rows = int(np.asarray(
+                        multihost_utils.process_allgather(
+                            np.asarray([float(self.num_data)]))).sum())
+            limit = _qhist.max_rows_for(config.quantized_grad_bits)
+            if n_rows > limit:
+                Log.warning(
+                    "quantized_training disabled: %d rows exceed the "
+                    "int32 histogram-accumulator headroom (%d rows at "
+                    "quantized_grad_bits=%d); training on f32 gradients",
+                    n_rows, limit, config.quantized_grad_bits)
+                config.quantized_training = False
+
         # device-resident training state
         self.bins = None if ooc_on else jnp.asarray(train_set.binned)
         self.num_bins = int(train_set.max_num_bin)
@@ -595,14 +621,28 @@ class GBDT:
         """Quantize one class's (N,) grad/hess to int16 for the exact
         integer histogram path (ops/qhist.py).
 
-        The scale is global over the selected rows (single-process: the
-        local abs-max IS global) and the stochastic-rounding seed is
-        value-keyed plus an (iteration, class) salt, so replays and row
-        shuffles reproduce the same quantized vectors bit for bit."""
+        The scale is global over the selected rows: under a multi-process
+        learner (ShardedLearner spanning hosts) the per-process abs-maxima
+        are allgathered and max-reduced first, so every process derives
+        the bit-identical scale — grow_tree psums the int32 histograms
+        across the whole mesh, which is only meaningful when all levels
+        share one scale.  The stochastic-rounding seed is value-keyed
+        plus an (iteration, class) salt, so replays and row shuffles
+        reproduce the same quantized vectors bit for bit."""
+        import jax as _jax
+
         from ..ops import qhist
 
         bits = self.config.quantized_grad_bits
-        mx = np.asarray(qhist.local_absmax(gk, hk, self.select))
+        mx = np.asarray(qhist.local_absmax(gk, hk, self.select), np.float32)
+        if _jax.process_count() > 1:
+            # same exchange HostParallelLearner does via its _QMAX blobs;
+            # max is order-invariant, so every process agrees exactly
+            from jax.experimental import multihost_utils
+
+            mx = np.asarray(
+                multihost_utils.process_allgather(mx), np.float32
+            ).max(axis=0)
         qscale_np = qhist.scales_from_max(mx[0], mx[1], bits)
         seed = (int(self.config.seed) * 2654435761
                 + self.iter * 97 + k * 131071 + 1) & 0xFFFFFFFF

@@ -31,10 +31,23 @@ reconstructs counts from the hessian plane and the node totals it
 already has.  If a per-bin sum overflows int16 the payload falls back to
 a length-discriminated int32 format (``F*B*8`` bytes) — still 1.5x
 smaller, and the receiver infers the width from the blob length alone.
+One degenerate case needs real counts: a node whose quantized hessians
+all round to 0 (small-hessian rows under a scale set by the global max)
+has ``sum_qh == 0``, and a derived count plane would be all zeros even
+though the node holds rows — min_data_in_leaf would then prune every
+split.  A sender detects this locally (hessians are non-negative, so the
+global hessian mass is zero iff every rank's is) and ships a 3-plane
+payload carrying its exact int count plane (``F*B*6`` / ``F*B*12``
+bytes); the receiver blends exact counts with the cnt_factor derivation
+for the remaining rows.  All four formats have distinct lengths, so the
+blob length alone still discriminates.
 
 ``QUANT_BITS`` defaults to 5 (QMAX=15): small enough that a 2-rank
 int16 wire sum holds ~2184 rows per bin per rank before the fallback
 triggers, while int32 device accumulation holds to ~143M rows per bin.
+That device bound is enforced at train time: boosting declines
+``quantized_training`` (with a warning) when the global row count
+exceeds :func:`max_rows_for`, instead of silently wrapping int32.
 """
 
 from __future__ import annotations
@@ -54,6 +67,17 @@ QUANT_BITS = 5
 def qmax_for(bits: int) -> int:
     """Largest quantized magnitude at a given signed bit width."""
     return (1 << (bits - 1)) - 1
+
+
+def max_rows_for(bits: int = QUANT_BITS) -> int:
+    """Largest global row count the int32 histogram accumulators can hold.
+
+    A node (and in the worst case a single bin) sums up to ``n * QMAX``
+    in int32 — both the root totals and the per-bin psum'd histogram
+    (ops/grow.py) — so past ``(2**31 - 1) // QMAX`` rows the accumulation
+    can wrap silently.  Training checks this bound up front and declines
+    quantized mode rather than producing wrong trees."""
+    return (2 ** 31 - 1) // qmax_for(bits)
 
 
 # ----------------------------------------------------------------------
@@ -93,13 +117,19 @@ def _hash_uniform(x: jnp.ndarray, key: jnp.ndarray) -> jnp.ndarray:
     A murmur3-style integer finalizer over ``bitcast(x) ^ key``: equal
     values always round the same way within an iteration (row-order
     invariance), different iterations re-draw (unbiasedness across the
-    boosting run).  No PRNG state, no row indices."""
+    boosting run).  No PRNG state, no row indices.
+
+    Only the top 24 hash bits are used: a 24-bit integer converts to
+    float32 exactly, so ``u <= (2**24 - 1) * 2**-24 < 1`` strictly.
+    Converting all 32 bits would round values within 128 of ``2**32``
+    UP to ``2**32`` and return exactly 1.0, pushing ``floor(x/s + u)``
+    a full unit high."""
     u = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
     u = u ^ key.astype(jnp.uint32)
     u = (u ^ (u >> 16)) * jnp.uint32(0x7FEB352D)
     u = (u ^ (u >> 15)) * jnp.uint32(0x846CA68B)
     u = u ^ (u >> 16)
-    return u.astype(jnp.float32) * jnp.float32(2.0 ** -32)
+    return (u >> 8).astype(jnp.float32) * jnp.float32(2.0 ** -24)
 
 
 @functools.partial(jax.jit, static_argnames=("bits",))
@@ -154,7 +184,8 @@ def dequantize_sums(sums_q: jnp.ndarray, scales: jnp.ndarray) -> jnp.ndarray:
     )
 
 
-def derive_count_plane(hist2: np.ndarray, node_cnt: float) -> np.ndarray:
+def derive_count_plane(hist2: np.ndarray, node_cnt: float,
+                       exact: np.ndarray = None) -> np.ndarray:
     """Reconstruct the count plane of a 2-plane quantized histogram.
 
     The reference's histograms are genuinely two-plane; counts come from
@@ -162,54 +193,93 @@ def derive_count_plane(hist2: np.ndarray, node_cnt: float) -> np.ndarray:
     node_sum_hess`` (feature_histogram.hpp).  Here the quantized-hessian
     plane plays that role: every row lands in exactly one bin of feature
     0, so feature 0's bins sum to the node's quantized-hessian total —
-    no extra wire traffic to learn it."""
+    no extra wire traffic to learn it.
+
+    ``exact`` is the summed (F, B) count plane of the ranks that shipped
+    3-plane payloads (their hessian mass quantized to zero, so derivation
+    could not see their rows).  Those rows are counted exactly; the
+    cnt_factor derivation covers only the remainder, whose hessian mass
+    is exactly the merged hessian plane (the exact-shippers contributed
+    zero to it)."""
     hist2 = np.asarray(hist2)
     qh_tot = int(hist2[0, :, 1].sum())
+    if exact is not None:
+        exact = np.asarray(exact, np.float32)
+        rest = max(float(node_cnt) - float(exact[0, :].sum()), 0.0)
+        cf = np.float32(rest) / np.float32(max(qh_tot, 1))
+        return exact + np.rint(
+            hist2[..., 1].astype(np.float32) * cf).astype(np.float32)
+    if qh_tot == 0 and float(node_cnt) > 0:
+        # no sender shipped counts yet the node holds rows: every bin
+        # derives to zero and min_data_in_leaf prunes all splits here.
+        # Reachable only when a sender skipped the 3-plane fallback
+        # (e.g. negative hessians break the local-zero test).
+        from ..utils.log import Log
+
+        Log.warning(
+            "quantized histogram node with %d rows has zero hessian "
+            "mass and no exact count plane; its splits will be pruned",
+            int(node_cnt))
     cf = np.float32(node_cnt) / np.float32(max(qh_tot, 1))
     return np.rint(hist2[..., 1].astype(np.float32) * cf).astype(np.float32)
 
 
 def assemble_hist(hist2: np.ndarray, scales: np.ndarray,
-                  node_cnt: float) -> np.ndarray:
-    """Merged 2-plane int wire histogram -> (F, B, 3) f32 for the scan."""
+                  node_cnt: float, counts: np.ndarray = None) -> np.ndarray:
+    """Merged 2-plane int wire histogram -> (F, B, 3) f32 for the scan.
+
+    ``counts`` forwards the merged exact count plane (if any 3-plane
+    payloads arrived) to :func:`derive_count_plane`."""
     hist2 = np.asarray(hist2)
     out = np.empty(hist2.shape[:2] + (3,), np.float32)
     out[..., 0] = hist2[..., 0].astype(np.float32) * np.float32(scales[0])
     out[..., 1] = hist2[..., 1].astype(np.float32) * np.float32(scales[1])
-    out[..., 2] = derive_count_plane(hist2, node_cnt)
+    out[..., 2] = derive_count_plane(hist2, node_cnt, exact=counts)
     return out
 
 
 # ----------------------------------------------------------------------
 # wire format (purpose tag "hist_q")
 # ----------------------------------------------------------------------
-def pack_hist_q(hist2) -> bytes:
+def pack_hist_q(hist2, counts=None) -> bytes:
     """Pack the (F, B, 2) int32 (sum_qg, sum_qh) planes for the wire.
 
     Primary format: little-endian int16, ``F*B*4`` bytes — 3x smaller
     than the f32x3 wire's ``F*B*12``.  If any per-bin sum exceeds int16
     range the whole payload falls back to int32 (``F*B*8`` bytes); the
-    receiver discriminates the two formats by blob length, so there is
-    no header byte to spoil the 3x arithmetic."""
+    receiver discriminates the formats by blob length, so there is no
+    header byte to spoil the 3x arithmetic.
+
+    ``counts`` (an exact (F, B) int count plane) appends a third plane
+    (``F*B*6`` / ``F*B*12`` bytes).  A sender ships it only when its
+    hessian mass for the node quantized to zero — without it the
+    receiver's derived count plane would miss these rows entirely."""
     arr = np.ascontiguousarray(np.asarray(hist2, np.int32))
+    if counts is not None:
+        arr = np.ascontiguousarray(np.concatenate(
+            [arr, np.asarray(counts, np.int32)[..., None]], axis=-1))
     if abs(int(arr.min(initial=0))) <= 32767 and int(arr.max(initial=0)) <= 32767:
         return arr.astype("<i2").tobytes()
     return arr.astype("<i4").tobytes()
 
 
 def unpack_hist_q(blob: bytes, num_features: int, num_bins: int) -> np.ndarray:
-    """Inverse of :func:`pack_hist_q` -> (F, B, 2) int32."""
-    n = num_features * num_bins * 2
-    if len(blob) == n * 2:
-        arr = np.frombuffer(blob, "<i2").astype(np.int32)
-    elif len(blob) == n * 4:
-        arr = np.frombuffer(blob, "<i4").astype(np.int32)
-    else:
+    """Inverse of :func:`pack_hist_q` -> (F, B, 2) or (F, B, 3) int32.
+
+    The last axis is 3 when the sender shipped its exact count plane
+    (all four lengths — {2, 3} planes x {int16, int32} — are distinct,
+    so the blob length alone picks the format)."""
+    m = num_features * num_bins
+    by_len = {m * 4: ("<i2", 2), m * 8: ("<i4", 2),
+              m * 6: ("<i2", 3), m * 12: ("<i4", 3)}
+    fmt = by_len.get(len(blob))
+    if fmt is None:
         raise ValueError(
             f"hist_q payload of {len(blob)} B matches neither the int16 "
-            f"({n * 2} B) nor the int32 ({n * 4} B) format for "
-            f"F={num_features}, B={num_bins}")
-    return arr.reshape(num_features, num_bins, 2)
+            f"({m * 4}/{m * 6} B) nor the int32 ({m * 8}/{m * 12} B) "
+            f"2/3-plane formats for F={num_features}, B={num_bins}")
+    arr = np.frombuffer(blob, fmt[0]).astype(np.int32)
+    return arr.reshape(num_features, num_bins, fmt[1])
 
 
 def wire_bytes_f32(num_features: int, num_bins: int) -> int:

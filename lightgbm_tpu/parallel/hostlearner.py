@@ -214,14 +214,36 @@ class HostParallelLearner:
             tot = tot + p
         return tot
 
-    def _merge_q(self, blobs: List[bytes], f: int, b: int) -> np.ndarray:
-        """Exact integer merge of 2-plane ``hist_q`` payloads — int64
-        adds are associative, so the merged planes are independent of
-        rank count and merge order (the quantized determinism anchor)."""
-        tot = qhist.unpack_hist_q(blobs[0], f, b).astype(np.int64)
-        for blob in blobs[1:]:
-            tot = tot + qhist.unpack_hist_q(blob, f, b)
-        return tot
+    def _merge_q(self, blobs: List[bytes], f: int, b: int):
+        """Exact integer merge of ``hist_q`` payloads — int64 adds are
+        associative, so the merged planes are independent of rank count
+        and merge order (the quantized determinism anchor).
+
+        Returns ``(planes, counts)``: the (F, B, 2) g/h sum and the
+        summed (F, B) exact count plane of any 3-plane payloads (ranks
+        whose hessian mass for the node quantized to zero), or None when
+        every rank shipped the 2-plane format."""
+        tot = np.zeros((f, b, 2), np.int64)
+        counts = None
+        for blob in blobs:
+            arr = qhist.unpack_hist_q(blob, f, b)
+            tot = tot + arr[..., :2]
+            if arr.shape[-1] == 3:
+                c = arr[..., 2].astype(np.int64)
+                counts = c if counts is None else counts + c
+        return tot, counts
+
+    @staticmethod
+    def _q_counts_if_degenerate(hist3: np.ndarray):
+        """Sender side of the degenerate-node protocol: the exact int
+        count plane iff this rank's quantized hessian mass for the node
+        is zero while it still holds rows (hessians are non-negative, so
+        the GLOBAL mass is zero iff every rank's is — each such rank
+        ships counts and the receiver needs no second exchange)."""
+        if (int(hist3[0, :, 1].sum()) == 0
+                and int(hist3[0, :, 2].sum()) > 0):
+            return hist3[..., 2]
+        return None
 
     # -- per-node best split, one exchange pattern per mode -----------
 
@@ -262,12 +284,15 @@ class HostParallelLearner:
             elif self.quant:
                 # 2-plane int16 wire (F*B*4 bytes vs the f32 wire's
                 # F*B*12), exact integer merge, count plane derived from
-                # the hessian plane + node totals (ops/qhist.py)
-                blob = qhist.pack_hist_q(np.asarray(hist)[..., :2])
+                # the hessian plane + node totals (ops/qhist.py); a rank
+                # with zero hessian mass here ships its counts exactly
+                h3 = np.asarray(hist)
+                blob = qhist.pack_hist_q(
+                    h3[..., :2], self._q_counts_if_degenerate(h3))
                 blobs = self.comm.allgather(blob, "hist_q")
-                merged = self._merge_q(blobs, f, p.num_bins)
+                merged, exact_cnt = self._merge_q(blobs, f, p.num_bins)
                 ghist = qhist.assemble_hist(merged, self._qscales,
-                                            float(sc))
+                                            float(sc), counts=exact_cnt)
                 fmask = feature_mask
             else:
                 blobs = self.comm.allgather(
@@ -326,14 +351,18 @@ class HostParallelLearner:
                 "voting-parallel election disagreed across ranks — "
                 "non-deterministic local gains?")
         if self.quant:
-            sub_q = np.asarray(qhist_local)[elected][..., :2]
-            parts = self.comm.allgather(qhist.pack_hist_q(sub_q), "hist_q")
-            merged_q = self._merge_q(parts, k2, p.num_bins)
+            sub3 = np.asarray(qhist_local)[elected]
+            parts = self.comm.allgather(
+                qhist.pack_hist_q(
+                    sub3[..., :2], self._q_counts_if_degenerate(sub3)),
+                "hist_q")
+            merged_q, exact_cnt = self._merge_q(parts, k2, p.num_bins)
             # every row lands in one bin of ANY feature, so the first
             # elected column's hessian plane sums to the node total the
             # cnt_factor derivation needs
             merged_sub = qhist.assemble_hist(merged_q, self._qscales,
-                                             float(node_cnt))
+                                             float(node_cnt),
+                                             counts=exact_cnt)
         else:
             sub = np.ascontiguousarray(np.asarray(hist, np.float32)[elected])
             parts = self.comm.allgather(sub.tobytes(), "hist")

@@ -185,6 +185,23 @@ class TestStochasticRounding:
                 g, jnp.zeros(3, jnp.float32), scales, np.uint32(seed))
             np.testing.assert_array_equal(np.asarray(qg), [10, -5, 0])
 
+    def test_hash_uniform_strictly_below_one(self):
+        # 75196197 is a bit pattern whose murmur finalizer output lands
+        # within 128 of 2**32: a raw uint32->f32 cast rounds it UP to
+        # 2**32, so the old conversion returned u == 1.0 exactly and
+        # floor(x/s + u) overshot by a full unit.  The 24-bit mask keeps
+        # the int->float cast exact and u < 1 strictly.
+        x = jnp.asarray(np.array([75196197], np.uint32).view(np.float32))
+        u = np.asarray(qhist._hash_uniform(x, jnp.uint32(0)))
+        assert float(u[0]) < 1.0
+        # granularity: every draw is an exact multiple of 2**-24 in [0, 1)
+        rng = np.random.default_rng(8)
+        xs = jnp.asarray(rng.normal(size=4096).astype(np.float32))
+        us = np.asarray(qhist._hash_uniform(xs, jnp.uint32(123)))
+        assert float(us.max()) < 1.0 and float(us.min()) >= 0.0
+        np.testing.assert_array_equal(us * 2.0 ** 24,
+                                      np.round(us * 2.0 ** 24))
+
     def test_value_keyed_row_order_invariance(self, small):
         n, f, B, bins, grad, hess = small
         qg, qh, _ = _quantize(grad, hess, seed=17)
@@ -347,6 +364,102 @@ class TestWireFormat:
         # node count within B/2
         assert float(np.abs(asm[..., 2].sum(axis=1) - n).max()) <= B / 2
         assert float(np.abs(asm[..., 2] - hist[..., 2]).max()) <= 32.0
+
+    def test_three_plane_roundtrip(self):
+        rng = np.random.default_rng(4)
+        F, B = 7, 8
+        hist2 = rng.integers(-100, 100, size=(F, B, 2)).astype(np.int32)
+        counts = rng.integers(0, 50, size=(F, B)).astype(np.int32)
+        blob = qhist.pack_hist_q(hist2, counts)
+        assert len(blob) == F * B * 6
+        out = qhist.unpack_hist_q(blob, F, B)
+        assert out.shape == (F, B, 3)
+        np.testing.assert_array_equal(out[..., :2], hist2)
+        np.testing.assert_array_equal(out[..., 2], counts)
+        # int32 fallback when any plane overflows int16
+        counts[0, 0] = 40_000
+        blob = qhist.pack_hist_q(hist2, counts)
+        assert len(blob) == F * B * 12
+        np.testing.assert_array_equal(
+            qhist.unpack_hist_q(blob, F, B)[..., 2], counts)
+
+    def test_degenerate_node_exact_counts(self):
+        # every hessian quantized to zero: derivation alone would zero
+        # the count plane and min_data_in_leaf would prune every split;
+        # the shipped exact plane must come through untouched
+        F, B = 3, 4
+        hist2 = np.zeros((F, B, 2), np.int64)
+        hist2[..., 0] = 5  # gradient mass only
+        counts = np.full((F, B), 7, np.int64)
+        asm = qhist.assemble_hist(hist2, np.asarray([0.1, 0.1], np.float32),
+                                  float(counts[0].sum()), counts=counts)
+        np.testing.assert_array_equal(asm[..., 2], counts)
+
+    def test_blended_exact_plus_derived_counts(self):
+        # rank A has hessian mass (2-plane wire); rank B's hessians all
+        # quantized to zero and it shipped exact counts.  B's rows count
+        # exactly; A's derive from the merged hessian plane, to which
+        # only A contributed.
+        F, B = 2, 4
+        merged = np.zeros((F, B, 2), np.int64)
+        merged[:, 0, 1] = 30  # A: 10 rows in bin 0, qh=3 each
+        exact_b = np.zeros((F, B), np.int64)
+        exact_b[:, 1] = 6  # B: 6 rows in bin 1
+        plane = qhist.derive_count_plane(merged, 16.0, exact=exact_b)
+        assert float(plane[0, 0]) == 10.0
+        assert float(plane[0, 1]) == 6.0
+
+    def test_degenerate_without_exact_counts_stays_zero(self):
+        # no sender shipped counts (e.g. negative hessians defeat the
+        # local-zero test): behavior is unchanged — zeros plus a warning
+        merged = np.zeros((3, 4, 2), np.int64)
+        plane = qhist.derive_count_plane(merged, 9.0)
+        np.testing.assert_array_equal(plane, 0.0)
+
+
+class TestDegenerateNodeProtocol:
+    def test_merge_mixed_plane_blobs(self):
+        F, B = 4, 8
+        h2 = np.ones((F, B, 2), np.int32)
+        cnt = np.full((F, B), 2, np.int32)
+        blobs = [qhist.pack_hist_q(h2), qhist.pack_hist_q(h2, cnt)]
+        tot, exact = HostParallelLearner._merge_q(None, blobs, F, B)
+        np.testing.assert_array_equal(tot, np.full((F, B, 2), 2))
+        np.testing.assert_array_equal(exact, cnt)
+        tot2, exact2 = HostParallelLearner._merge_q(
+            None, [qhist.pack_hist_q(h2)] * 2, F, B)
+        np.testing.assert_array_equal(tot2, np.full((F, B, 2), 2))
+        assert exact2 is None
+
+    def test_sender_ships_counts_only_when_hessless(self):
+        F, B = 3, 4
+        h3 = np.zeros((F, B, 3), np.int32)
+        h3[..., 2] = 1  # rows present, zero hessian mass
+        np.testing.assert_array_equal(
+            HostParallelLearner._q_counts_if_degenerate(h3), h3[..., 2])
+        h3[0, 0, 1] = 4  # hessian mass -> normal 2-plane wire
+        assert HostParallelLearner._q_counts_if_degenerate(h3) is None
+        # empty node: nothing to protect
+        assert HostParallelLearner._q_counts_if_degenerate(
+            np.zeros((F, B, 3), np.int32)) is None
+
+
+class TestAccumulatorHeadroom:
+    def test_max_rows_for(self):
+        assert qhist.max_rows_for(5) == (2 ** 31 - 1) // 15
+        assert qhist.max_rows_for(2) > qhist.max_rows_for(8)
+
+    def test_engine_declines_past_headroom(self, trainable, monkeypatch):
+        # past the int32 accumulation bound the flag is dropped with a
+        # warning and training proceeds bit-identically to the f32 path
+        X, y = trainable
+        monkeypatch.setattr(qhist, "max_rows_for", lambda bits=5: 100)
+        p = dict(objective="binary", num_leaves=15, learning_rate=0.1,
+                 min_data_in_leaf=5, verbose=-1, seed=7)
+        bst_q = lgb.train(dict(p, quantized_training=True),
+                          lgb.Dataset(X, label=y), num_boost_round=3)
+        bst_f = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=3)
+        np.testing.assert_array_equal(bst_q.predict(X), bst_f.predict(X))
 
 
 # ----------------------------------------------------------------------
