@@ -100,6 +100,20 @@ def apply_regression_gate(out: dict, bench_dir: str = None, env=None) -> int:
         if float(ratio_q) < 3.0:
             out["regression_quantized_hist_payload"] = True
             rc = 1
+    # elastic recovery leg, same regime: the injected per-collective
+    # stall dominates compute on any backend, so rebalance-on must beat
+    # rebalance-off by >=1.3x under the ~4x straggler on EVERY capture —
+    # CPU fallback included (docs/ROBUSTNESS.md)
+    el = out.get("elastic") or {}
+    rr = el.get("recovery_ratio")
+    if el and not el.get("error") and isinstance(rr, (int, float)):
+        out["gate_elastic"] = {
+            "min_recovery_ratio": 1.3,
+            "recovery_ratio": round(float(rr), 2),
+        }
+        if float(rr) < 1.3:
+            out["regression_elastic_recovery"] = True
+            rc = 1
     if out.get("backend_fallback"):
         return rc
     best, src = best_prior_sec_per_iter(bench_dir, out.get("metric"))
@@ -1267,6 +1281,108 @@ def _bench_comms():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _bench_elastic():
+    """Elastic straggler A/B (docs/ROBUSTNESS.md): three REAL 2-rank
+    subprocess fleets (tests/elastic_worker.py over the KV transport)
+    training the same data-parallel job —
+
+      no_straggler        — clean baseline
+      straggler_off       — rank 0 sleeps ``delay:ms:after:N`` at every
+                            hardened collective (a ~4x per-row-slow
+                            host), rebalancing DISABLED
+      straggler_rebalance — same fault, ``rebalance=true``: the
+                            controller moves rows off the slow rank and
+                            the injected stall shrinks with them
+                            (net.set_delay_scale ties sleep to the
+                            current/initial row ratio)
+
+    reporting steady-state s/iter (tail iterations, past warmup and the
+    move) and ``recovery_ratio = off / on``.  The injected stall
+    dominates compute on ANY backend, so the >=1.3x recovery contract is
+    device-independent and gates outright even on backend_fallback
+    captures (apply_regression_gate).  BENCH_ELASTIC=0 skips;
+    BENCH_ELASTIC_ROWS / BENCH_ELASTIC_TREES / BENCH_ELASTIC_DELAY_MS
+    resize."""
+    import socket
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tests", "elastic_worker.py")
+    rows = int(os.environ.get("BENCH_ELASTIC_ROWS", 1024))
+    trees = int(os.environ.get("BENCH_ELASTIC_TREES", 14))
+    delay_ms = int(os.environ.get("BENCH_ELASTIC_DELAY_MS", 30))
+    tail = 5  # steady-state window: past warmup AND past the move
+    try:
+        if not os.path.exists(worker):
+            return {"error": f"FileNotFoundError: {worker}"}
+
+        def fleet(tag, extra_env, tmp):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            base = {k: v for k, v in os.environ.items()
+                    if k not in ("XLA_FLAGS", "JAX_PLATFORMS",
+                                 "LIGHTGBM_TPU_FAULT",
+                                 "LIGHTGBM_TPU_FAULT_RANK",
+                                 "LIGHTGBM_TPU_TRACE")}
+            repo = os.path.dirname(os.path.abspath(__file__))
+            base["PYTHONPATH"] = repo + os.pathsep + base.get(
+                "PYTHONPATH", "")
+            base.update(ELASTIC_ROWS=str(rows), ELASTIC_TREES=str(trees),
+                        ELASTIC_FREQ="100")  # no checkpoint I/O on the clock
+            base.update(extra_env)
+            outp = os.path.join(tmp, tag)
+            procs = [subprocess.Popen(
+                [_sys.executable, worker, str(r), "2", str(port), outp,
+                 "train", os.path.join(tmp, tag + "_ck")],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=base) for r in range(2)]
+            logs = [p.communicate(timeout=600)[0] for p in procs]
+            if any(p.returncode != 0 for p in procs):
+                raise RuntimeError(
+                    "elastic fleet failed: " + logs[0][-500:])
+            res = []
+            for r in range(2):
+                with open(outp + f".rank{r}.json") as fh:
+                    res.append(json.load(fh))
+            return res
+
+        fault = {"LIGHTGBM_TPU_FAULT": f"delay:{delay_ms}:after:5",
+                 "LIGHTGBM_TPU_FAULT_RANK": "0"}
+
+        def s_per_iter(res):
+            # ranks run in lockstep (barrier-synchronized); the fleet
+            # pace is either rank's tail-mean
+            ts = res[0]["it_times"][-tail:]
+            return sum(ts) / max(len(ts), 1)
+
+        with tempfile.TemporaryDirectory(prefix="bench_elastic_") as tmp:
+            base_r = fleet("base", {}, tmp)
+            off_r = fleet("off", dict(fault), tmp)
+            on_r = fleet("on", dict(fault, ELASTIC_REBALANCE="1",
+                                    ELASTIC_MOVE_FRAC="0.6"), tmp)
+        base_s = s_per_iter(base_r)
+        off_s = s_per_iter(off_r)
+        on_s = s_per_iter(on_r)
+        return {
+            "rows": rows, "trees": trees, "ranks": 2,
+            "delay_ms_per_collective": delay_ms,
+            "no_straggler_s_per_iter": round(base_s, 4),
+            "straggler_off_s_per_iter": round(off_s, 4),
+            "straggler_rebalance_s_per_iter": round(on_s, 4),
+            "straggler_slowdown": (round(off_s / base_s, 2)
+                                   if base_s > 0 else None),
+            "recovery_ratio": (round(off_s / on_s, 2)
+                               if on_s > 0 else None),
+            "final_counts": on_r[0]["final_counts"],
+        }
+    except Exception as e:  # pragma: no cover
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _auc(y, s):
     """AUC via the library's own metric (one implementation to trust)."""
     from lightgbm_tpu.config import Config
@@ -1673,6 +1789,15 @@ def main():
     # device-independent leg of the regression gate.
     if os.environ.get("BENCH_COMMS", "1") != "0":
         out["comms"] = _bench_comms()
+
+    # elastic section (docs/ROBUSTNESS.md): straggler A/B over real
+    # 2-rank subprocess fleets — s/iter {no-straggler, straggler with
+    # rebalance off, straggler with rebalance on} and the recovery
+    # ratio.  Runs even on backend_fallback: the injected stall
+    # dominates on any backend, so the >=1.3x recovery contract is the
+    # device-independent leg of the regression gate.
+    if os.environ.get("BENCH_ELASTIC", "1") != "0":
+        out["elastic"] = _bench_elastic()
 
     # kernel A/B section (docs/PERFORMANCE.md): the PR-6 kernel wins
     # measured head-to-head WITH parity checks — on a dead tunnel this is

@@ -74,6 +74,7 @@ class GBDT:
         self.config = None
         self.max_feature_idx = 0
         self.label_idx = 0
+        self._rebalance = None
 
     # ------------------------------------------------------------------
     def init(self, config, train_set, objective, training_metrics=()):
@@ -306,6 +307,15 @@ class GBDT:
         self.class_need_train = [True] * k
         self.class_default_output = [0.0] * k
 
+        # straggler-aware shard rebalancing (parallel/shardplan.py):
+        # armed only when rebalance=true AND the learner actually owns a
+        # row shard; OFF is the exact pre-existing static-shard behavior
+        # (zero extra collectives)
+        self._rebalance = None
+        self._initial_local_rows = int(self.num_data)
+        if getattr(config, "rebalance", False):
+            self._init_rebalance()
+
     def add_valid(self, valid_set, valid_metrics, name: str):
         """GBDT::AddValidDataset (gbdt.cpp:220-250)."""
         self.valid_sets.append(valid_set)
@@ -425,6 +435,9 @@ class GBDT:
         if self.ptrainer is not None and gradients is None:
             return self.train_iters_partitioned(1, is_eval=is_eval)
 
+        import time as _time
+
+        t_iter0 = _time.perf_counter()
         self._boost_from_average()
 
         # comms-volume accounting: the host-driven parallel learners keep
@@ -527,6 +540,10 @@ class GBDT:
         if self.ptrainer is not None:
             # scores advanced outside the partitioned channel
             self.ptrainer.score_dirty = True
+        if self._rebalance is not None:
+            # lockstep on every rank: the tree growing above is
+            # collective, so all ranks reach this boundary together
+            self._maybe_rebalance(_time.perf_counter() - t_iter0)
         if is_eval:
             return self.eval_and_check_early_stopping()
         return False
@@ -825,6 +842,169 @@ class GBDT:
         return out
 
     # ------------------------------------------------------------------
+    # ------------------------------------------------------------------
+    # straggler-aware shard rebalancing (parallel/shardplan.py)
+    # ------------------------------------------------------------------
+    def _init_rebalance(self) -> None:
+        """Arm the rebalance controller when this run actually owns a
+        row shard; otherwise log why the knob is ignored."""
+        import jax as _jax
+
+        from ..parallel.hostlearner import HostParallelLearner
+
+        nproc = _jax.process_count()
+        md = self.train_set.metadata
+        why = None
+        if nproc <= 1:
+            why = "single process (nothing to rebalance)"
+        elif self.ptrainer is not None:
+            why = "fused partitioned trainer (static device layout)"
+        elif self.ooc is not None:
+            why = "out-of-core streaming (rows are disk-resident)"
+        elif self.learner is None:
+            why = "serial learner"
+        elif (isinstance(self.learner, HostParallelLearner)
+              and self.learner.mode == "feature"):
+            why = "feature-parallel learner (columns are sharded, not rows)"
+        elif md.query_boundaries is not None:
+            why = "query groups pin rows to their rank"
+        elif md.init_score is not None:
+            why = "per-row init_score is not relocatable yet"
+        if why is not None:
+            Log.warning("rebalance=true ignored: %s", why)
+            return
+        from ..parallel.collect import allgather_bytes
+        from ..parallel.shardplan import RebalanceController, ShardPlan
+
+        counts = [
+            int.from_bytes(g, "little")
+            for g in allgather_bytes(
+                int(self.num_data).to_bytes(8, "little"),
+                purpose="rebalance")
+        ]
+        self._rebalance = {
+            "plan": ShardPlan.from_counts(counts),
+            "ctl": RebalanceController(
+                threshold=self.config.rebalance_threshold,
+                patience=self.config.rebalance_patience,
+                max_move_frac=self.config.rebalance_max_move_frac,
+            ),
+            "rank": _jax.process_index(),
+        }
+        Log.info(
+            "Shard rebalancing armed: shards=%s threshold=%.2f "
+            "patience=%d max_move_frac=%.2f", counts,
+            self.config.rebalance_threshold,
+            self.config.rebalance_patience,
+            self.config.rebalance_max_move_frac,
+        )
+
+    def _maybe_rebalance(self, wall_s: float) -> None:
+        """Once per iteration, in lockstep on every rank: exchange the
+        tiny per-rank compute/wait/heartbeat table, run the identical
+        deterministic controller on it, and apply the plan it proposes
+        at this iteration boundary."""
+        import json as _json
+
+        from ..parallel import net as _net
+        from ..parallel.collect import allgather_bytes
+
+        rb = self._rebalance
+        wait_s = _net.wait_clock_drain()
+        compute_s = max(wall_s - wait_s, 0.0)
+        hb_age = 0.0
+        watch = _net.peer_watch()
+        if watch is not None:
+            ages = watch.ages()
+            if ages:
+                hb_age = max(float(v) for v in ages.values())
+        entry = {"compute_s": compute_s, "wait_s": wait_s,
+                 "hb_age": hb_age}
+        table = [
+            _json.loads(g)
+            for g in allgather_bytes(_json.dumps(entry).encode(),
+                                     purpose="rebalance")
+        ]
+        plan = rb["plan"]
+        new_plan = rb["ctl"].observe(
+            plan,
+            [t["compute_s"] for t in table],
+            [t["hb_age"] for t in table],
+        )
+        if new_plan is None:
+            return
+        tracer.event(
+            "rebalance.trigger", iter=self.iter,
+            compute_s=[round(float(t["compute_s"]), 4) for t in table],
+            wait_s=[round(float(t["wait_s"]), 4) for t in table],
+        )
+        self._apply_rebalance(plan, new_plan)
+
+    def _apply_rebalance(self, old_plan, new_plan) -> None:
+        """Move row blocks to the new plan — 'checkpoint reshape in
+        RAM': the same contiguous-slice semantics as the elastic restore
+        path (ckpt/state.py reshard_to_local), applied to the live
+        dataset/score/bagging state, then every row-derived binding is
+        refreshed."""
+        from ..parallel import net as _net
+        from ..parallel.shardplan import exchange_rows
+
+        rank = self._rebalance["rank"]
+        md = self.train_set.metadata
+        blocks = {
+            "binned": (np.asarray(self.train_set.binned), 0),
+            "label": (np.asarray(md.label), 0),
+            "scores": (np.asarray(self.scores, np.float32), 1),
+            "select": (np.asarray(self.select, np.float32), 0),
+        }
+        if md.weights is not None:
+            blocks["weights"] = (np.asarray(md.weights), 0)
+        if getattr(self.train_set, "bundled", None) is not None:
+            blocks["bundled"] = (np.asarray(self.train_set.bundled), 0)
+        moved = exchange_rows(old_plan, new_plan, rank, blocks)
+        n_new = int(new_plan.counts[rank])
+
+        self.train_set.binned = moved["binned"]
+        if "bundled" in moved:
+            self.train_set.bundled = moved["bundled"]
+        md.num_data = n_new
+        md.label = moved["label"]
+        if "weights" in moved:
+            md.weights = moved["weights"]
+        # the shard's rows changed: cached checkpoint fingerprints are
+        # stale (the GLOBAL fingerprint is invariant — contiguous
+        # rank-ordered partition is preserved)
+        for attr in ("_ckpt_fingerprint", "_ckpt_fp_parts"):
+            if getattr(self.train_set, attr, None) is not None:
+                setattr(self.train_set, attr, None)
+
+        self.num_data = n_new
+        if self.bins is not None:
+            self.bins = jnp.asarray(self.train_set.binned)
+        self.scores = jnp.asarray(moved["scores"])
+        self.select = jnp.asarray(moved["select"])
+        # objective/metrics bind per-row device arrays at init
+        if self.objective is not None:
+            self.objective.init(md, n_new)
+        for metric in self.training_metrics:
+            metric.init(md, n_new)
+        if self.learner is not None and hasattr(self.learner, "set_plan"):
+            self.learner.set_plan(new_plan)
+        self._rebalance["plan"] = new_plan
+        # injected per-collective delays model per-row-slow hosts: their
+        # stall shrinks with the rank's row share (bench.py elastic)
+        _net.set_delay_scale(n_new / max(self._initial_local_rows, 1))
+        moved_rows = sum(
+            max(0, a - b) for a, b in zip(old_plan.counts, new_plan.counts)
+        )
+        tracer.counter("rebalance.move_rows", float(moved_rows))
+        tracer.event("rebalance.plan", iter=self.iter,
+                     before=list(old_plan.counts),
+                     after=list(new_plan.counts))
+        Log.info("Rebalanced shards at iteration %d: %s -> %s "
+                 "(%d rows moved)", self.iter, list(old_plan.counts),
+                 list(new_plan.counts), moved_rows)
+
     def export_train_state(self):
         """Checkpoint hook (ckpt/state.py): everything beyond the
         config/dataset/trees that the next iteration reads — score

@@ -266,10 +266,18 @@ class PartitionedTrainer:
         if rowid.shape != (self.num_rows,):
             from ..utils.log import Log
 
-            Log.fatal(
-                "checkpoint row permutation has shape %s, expected (%d,)",
+            # topology changed since the save (elastic resume): the
+            # saved layout is meaningless for this partition — keep the
+            # identity packing (a valid continuation; score channels
+            # re-sync from the restored scores) instead of refusing
+            Log.warning(
+                "checkpoint row permutation has shape %s, expected (%d,); "
+                "keeping identity layout (topology changed since save)",
                 rowid.shape, self.num_rows,
             )
+            self._last_tree = None
+            self.score_dirty = True
+            return
         head = jnp.take(self.p[:, : self.num_rows], jnp.asarray(rowid), axis=1)
         self.p = jnp.concatenate([head, self.p[:, self.num_rows:]], axis=1)
         self._last_tree = None
@@ -1189,10 +1197,17 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
         if rowid.shape != (self.d, self.num_rows):
             from ..utils.log import Log
 
-            Log.fatal(
-                "checkpoint shard permutation has shape %s, expected (%d, %d)",
-                rowid.shape, self.d, self.num_rows,
+            # elastic resume onto a different device/host grid: the
+            # saved shard layout no longer applies — keep identity
+            # packing (valid continuation, scores re-sync exactly)
+            Log.warning(
+                "checkpoint shard permutation has shape %s, expected "
+                "(%d, %d); keeping identity layout (topology changed "
+                "since save)", rowid.shape, self.d, self.num_rows,
             )
+            self._last_tree = None
+            self.score_dirty = True
+            return
         nl = self.num_rows
         bufs, devs = [], []
         for s in self._local_shards_sorted():

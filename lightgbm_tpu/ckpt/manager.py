@@ -43,7 +43,9 @@ import numpy as np
 
 from ..obs import tracer
 from ..utils.log import Log
-from .state import TrainState, capture, restore
+from .state import (CheckpointMismatch, TrainState, capture,
+                    combine_fingerprint_parts, data_fingerprint_parts,
+                    merge_to_canonical, reshard_to_local, restore)
 from .store import CheckpointStore
 
 
@@ -192,7 +194,14 @@ class CheckpointManager:
             self._last_saved = step
             if jax.process_index() != 0:
                 return step  # host 0 owns the write
-            blob = _wrap_hosts([g[8:] for g in gathered])
+            # canonical global layout (docs/CHECKPOINT.md): merge the
+            # rank states into one global-row-order container so the
+            # checkpoint resumes at ANY world size, not just this one
+            with tracer.span("ckpt.merge_canonical", iter=step,
+                             world=nproc):
+                blob = merge_to_canonical(
+                    [TrainState.from_bytes(g[8:]) for g in gathered]
+                ).to_bytes()
 
         self._last_saved = step
         if self.background and not sync:
@@ -267,12 +276,60 @@ class CheckpointManager:
 
         import jax
 
-        blob = _unwrap_host(blob, jax.process_index())
+        rank, nproc = jax.process_index(), jax.process_count()
+        blob = _unwrap_host(blob, rank)  # legacy per-rank containers only
         state = TrainState.from_bytes(blob)
+        if "world_size" in state.meta:
+            state = self._reshard_to_current(booster, state, rank, nproc)
         restore(booster, state)
         self._restore_callbacks(state)
         self._last_saved = step
         return state
+
+    def _reshard_to_current(self, booster, state: TrainState, rank: int,
+                            nproc: int) -> TrainState:
+        """Adapt a canonical global-layout checkpoint to the current
+        topology.  All ranks enter in lockstep (they all read the same
+        container): a tiny allgather of per-rank row counts + CRC
+        primitives establishes the current partition and proves the
+        concatenated shards are byte-for-byte the saved global dataset
+        before any state is sliced."""
+        b = booster.boosting
+        local_rows = int(b.num_data)
+        valid_rows = [int(np.asarray(vs).shape[1]) for vs in b.valid_scores]
+        parts = data_fingerprint_parts(b.train_set)
+        entry = {"rows": local_rows, "valid": valid_rows, "parts": parts}
+        if nproc > 1:
+            from ..parallel.collect import allgather_bytes
+
+            gathered = [
+                json.loads(g)
+                for g in allgather_bytes(
+                    json.dumps(entry).encode(), purpose="ckpt_reshard")
+            ]
+        else:
+            gathered = [entry]
+        shard_rows = [int(g["rows"]) for g in gathered]
+        valid_shard = [[int(g["valid"][i]) for g in gathered]
+                       for i in range(len(valid_rows))]
+        global_fp = combine_fingerprint_parts([g["parts"] for g in gathered])
+        if global_fp != state.meta["data_fingerprint"]:
+            raise CheckpointMismatch(
+                "checkpoint was written against a different global dataset "
+                f"(checkpoint {state.meta['data_fingerprint']}, run "
+                f"{global_fp}); refusing to resume"
+            )
+        local_fp = combine_fingerprint_parts([parts])
+        saved_w = int(state.meta.get("world_size", 1))
+        if saved_w != nproc:
+            Log.info(
+                "Resharding checkpoint from world size %d to %d "
+                "(canonical global layout)", saved_w, nproc,
+            )
+        return reshard_to_local(
+            state, rank, shard_rows, valid_shard, local_fp,
+            bag_seed=int(getattr(b.config, "bagging_seed", 0)),
+        )
 
     # -- tracked-callback state ----------------------------------------
     def _callback_state(self) -> Dict[str, Any]:

@@ -81,6 +81,13 @@ _FP_VOLATILE = {
     # math-relevant out_of_core/ooc_chunk_rows stay fingerprinted, and
     # the chunk grid itself is checked via meta["ooc_schedule"])
     "ooc_prefetch_depth",
+    # topology-portable checkpoints: the world size is recorded in the
+    # canonical container's metadata, not in the config fingerprint — a
+    # world-4 checkpoint must resume at world 2/8 (docs/CHECKPOINT.md).
+    # The rebalance policy knobs only steer WHEN shards move, never the
+    # per-iteration math on a given shard layout.
+    "num_machines", "rebalance", "rebalance_threshold",
+    "rebalance_patience", "rebalance_max_move_frac",
 }
 
 
@@ -122,6 +129,112 @@ def data_fingerprint(binned_ds) -> str:
     fp = f"{binned.shape[0]}x{binned.shape[1]}:{crc & 0xFFFFFFFF:08x}"
     binned_ds._ckpt_fingerprint = fp
     return fp
+
+
+# -- shard-composable fingerprints -------------------------------------
+# Under the pre-partition contract the global dataset is the row-order
+# concatenation of the rank shards, so the global data_fingerprint is
+# derivable from per-shard CRC primitives via zlib's crc32_combine
+# identity crc(A||B) = combine(crc(A), crc(B), len(B)) — no rank ever
+# has to materialize (or even see) another rank's rows.
+
+def _gf2_matrix_times(mat, vec: int) -> int:
+    out = 0
+    i = 0
+    while vec:
+        if vec & 1:
+            out ^= mat[i]
+        vec >>= 1
+        i += 1
+    return out
+
+
+def _gf2_matrix_square(square, mat) -> None:
+    for n in range(32):
+        square[n] = _gf2_matrix_times(mat, mat[n])
+
+
+def crc32_combine(crc1: int, crc2: int, len2: int) -> int:
+    """zlib's crc32_combine: CRC of the concatenation A||B from
+    ``crc32(A)``, ``crc32(B)`` and ``len(B)`` (GF(2) matrix powering of
+    the CRC polynomial over len2 zero bytes)."""
+    if len2 <= 0:
+        return crc1 & 0xFFFFFFFF
+    even = [0] * 32
+    odd = [0] * 32
+    odd[0] = 0xEDB88320  # CRC-32 polynomial, reflected
+    row = 1
+    for n in range(1, 32):
+        odd[n] = row
+        row <<= 1
+    _gf2_matrix_square(even, odd)
+    _gf2_matrix_square(odd, even)
+    crc1 &= 0xFFFFFFFF
+    while True:
+        _gf2_matrix_square(even, odd)
+        if len2 & 1:
+            crc1 = _gf2_matrix_times(even, crc1)
+        len2 >>= 1
+        if len2 == 0:
+            break
+        _gf2_matrix_square(odd, even)
+        if len2 & 1:
+            crc1 = _gf2_matrix_times(odd, crc1)
+        len2 >>= 1
+        if len2 == 0:
+            break
+    return (crc1 ^ (crc2 & 0xFFFFFFFF)) & 0xFFFFFFFF
+
+
+def data_fingerprint_parts(binned_ds) -> Dict[str, int]:
+    """CRC primitives of one shard, composable across shards: separate
+    binned-matrix and label CRCs plus their byte lengths and the row
+    grid.  :func:`combine_fingerprint_parts` folds a rank-ordered list
+    of these into the exact string :func:`data_fingerprint` would
+    produce over the concatenated rows."""
+    cached = getattr(binned_ds, "_ckpt_fp_parts", None)
+    if cached is not None:
+        return dict(cached)
+    binned = np.asarray(binned_ds.binned)
+    crc_b = 0
+    step = 65536
+    for s in range(0, binned.shape[0], step):
+        crc_b = zlib.crc32(
+            np.ascontiguousarray(binned[s: s + step]).tobytes(), crc_b)
+    label = binned_ds.metadata.label
+    crc_l, len_l = 0, 0
+    if label is not None:
+        lab = np.ascontiguousarray(np.asarray(label)).tobytes()
+        crc_l, len_l = zlib.crc32(lab), len(lab)
+    parts = {
+        "rows": int(binned.shape[0]), "cols": int(binned.shape[1]),
+        "crc_binned": crc_b & 0xFFFFFFFF, "len_binned": int(binned.nbytes),
+        "crc_label": crc_l & 0xFFFFFFFF, "len_label": int(len_l),
+    }
+    binned_ds._ckpt_fp_parts = dict(parts)
+    return parts
+
+
+def combine_fingerprint_parts(parts) -> str:
+    """Rank-ordered shard parts -> the global-dataset fingerprint (equal
+    to :func:`data_fingerprint` over the row concatenation)."""
+    parts = [dict(p) for p in parts]
+    rows = sum(int(p["rows"]) for p in parts)
+    cols = int(parts[0]["cols"]) if parts else 0
+    crc_b = 0
+    for p in parts:
+        if int(p["cols"]) != cols:
+            raise CheckpointMismatch(
+                f"shard column counts disagree: {cols} vs {p['cols']}")
+        crc_b = crc32_combine(crc_b, int(p["crc_binned"]),
+                              int(p["len_binned"]))
+    crc_l, len_l = 0, 0
+    for p in parts:
+        crc_l = crc32_combine(crc_l, int(p["crc_label"]),
+                              int(p["len_label"]))
+        len_l += int(p["len_label"])
+    crc = crc32_combine(crc_b, crc_l, len_l)
+    return f"{rows}x{cols}:{crc & 0xFFFFFFFF:08x}"
 
 
 # ----------------------------------------------------------------------
@@ -238,6 +351,10 @@ def capture(booster, extra_py: Optional[Dict[str, Any]] = None) -> TrainState:
             "num_data": int(b.num_data),
             "config_fingerprint": config_fingerprint(b.config),
             "data_fingerprint": data_fingerprint(b.train_set),
+            # shard-composable CRC primitives: lets host 0 derive the
+            # GLOBAL dataset fingerprint for the canonical multi-host
+            # container without seeing any other rank's rows
+            "data_fingerprint_parts": data_fingerprint_parts(b.train_set),
             "num_valid": len(b.valid_scores),
             "best_iteration": int(getattr(booster, "best_iteration", -1)),
         }
@@ -303,3 +420,158 @@ def restore(booster, state: TrainState) -> TrainState:
     Log.info("Resumed training state at iteration %d (%d trees)",
              state.iteration, len(b.models))
     return state
+
+
+# ----------------------------------------------------------------------
+# topology-portable canonical layout (multi-host save / elastic resume)
+# ----------------------------------------------------------------------
+# Under the pre-partition contract the global row order is the rank-order
+# concatenation of the shards, so one canonical global-row-order
+# TrainState represents the fleet regardless of world size: save gathers
+# every rank's local state and merges row arrays by concatenation;
+# restore slices the SAME container to whatever partition the current
+# topology uses.  Shard rebalancing reuses this pair as "checkpoint
+# reshape in RAM" (parallel/shardplan.py) — one mechanism, tested two
+# ways.
+
+def merge_to_canonical(states) -> TrainState:
+    """Per-rank ``TrainState``s (rank order) -> one canonical global
+    TrainState.  Row arrays are concatenated in rank order; replicated
+    state (trees, feature RNG, GOSS key) comes from rank 0; genuinely
+    per-rank state (bagging RNG stream, early-stopping bests, callback
+    closures) is kept per rank so a same-partition resume stays
+    byte-identical."""
+    if not states:
+        raise ValueError("merge_to_canonical needs at least one state")
+    base = states[0]
+    iters = {int(s.meta["iteration"]) for s in states}
+    if len(iters) != 1:
+        raise CheckpointMismatch(
+            f"cannot merge rank states from divergent iterations: {sorted(iters)}")
+    nv = int(base.meta["num_valid"])
+    shard_rows = [int(s.meta["num_data"]) for s in states]
+    parts = []
+    for r, s in enumerate(states):
+        p = s.meta.get("data_fingerprint_parts")
+        if not p:
+            raise ValueError(
+                f"rank {r} state lacks data_fingerprint_parts; cannot "
+                "derive the global dataset fingerprint")
+        parts.append(p)
+    valid_shard = [
+        [int(np.asarray(s.arrays[f"valid_scores_{i}"]).shape[1])
+         for s in states]
+        for i in range(nv)
+    ]
+    arrays = dict(base.arrays)
+    arrays["scores"] = np.concatenate(
+        [np.asarray(s.arrays["scores"]) for s in states], axis=1)
+    arrays["select"] = np.concatenate(
+        [np.asarray(s.arrays["select"]) for s in states], axis=0)
+    for i in range(nv):
+        arrays[f"valid_scores_{i}"] = np.concatenate(
+            [np.asarray(s.arrays[f"valid_scores_{i}"]) for s in states],
+            axis=1)
+    arrays.pop("bag_rng_keys", None)
+    for r, s in enumerate(states):
+        arrays[f"bag_rng_keys_r{r}"] = np.asarray(
+            s.arrays["bag_rng_keys"], np.uint32)
+    py = dict(base.py)
+    py["per_rank"] = {
+        str(r): {
+            "py": {k: v for k, v in s.py.items() if k != "per_rank"},
+            "best_iteration": int(s.meta.get("best_iteration", -1)),
+        }
+        for r, s in enumerate(states)
+    }
+    meta = dict(base.meta)
+    meta.pop("data_fingerprint_parts", None)
+    meta["world_size"] = len(states)
+    meta["shard_rows"] = shard_rows
+    meta["valid_shard_rows"] = valid_shard
+    meta["num_data"] = int(sum(shard_rows))
+    meta["data_fingerprint"] = combine_fingerprint_parts(parts)
+    return TrainState(meta, py, arrays)
+
+
+def reshard_to_local(state: TrainState, rank: int, shard_rows,
+                     valid_shard_rows, local_fp: str,
+                     bag_seed: int = 0) -> TrainState:
+    """Slice a canonical global TrainState down to one rank of the
+    CURRENT topology (``shard_rows``/``valid_shard_rows`` describe the
+    current contiguous partition, in rank order; the caller has already
+    verified the global fingerprint and row totals).
+
+    When the current partition equals the saved one, the rank's own
+    bagging stream / bests / callback state are restored exactly —
+    same-world resume stays byte-identical.  Otherwise the row arrays
+    are resliced (a valid continuation: score caches and the bagging
+    mask travel with their rows) and the bagging RNG is reseeded
+    deterministically from ``(bag_seed, iteration, rank)`` — replaying
+    a sibling rank's stream on a different row count would be
+    meaningless anyway."""
+    from ..obs import tracer
+
+    meta = dict(state.meta)
+    saved_rows = [int(x) for x in meta.get("shard_rows", [])]
+    saved_valid = [[int(x) for x in v]
+                   for v in meta.get("valid_shard_rows", [])]
+    shard_rows = [int(x) for x in shard_rows]
+    valid_shard_rows = [[int(x) for x in v] for v in valid_shard_rows]
+    total = sum(shard_rows)
+    if total != int(meta["num_data"]):
+        raise CheckpointMismatch(
+            f"checkpoint holds {meta['num_data']} global rows but the "
+            f"current topology partitions {total}")
+    for i, v in enumerate(valid_shard_rows):
+        if i < len(saved_valid) and sum(v) != sum(saved_valid[i]):
+            raise CheckpointMismatch(
+                f"valid set {i} holds {sum(saved_valid[i])} global rows "
+                f"but the current topology partitions {sum(v)}")
+    same_partition = (saved_rows == shard_rows
+                      and saved_valid == valid_shard_rows)
+    start = sum(shard_rows[:rank])
+    stop = start + shard_rows[rank]
+    with tracer.span("ckpt.reshard", rank=rank,
+                     saved_world=int(meta.get("world_size", 1)),
+                     world=len(shard_rows),
+                     same_partition=same_partition):
+        arrays: Dict[str, np.ndarray] = {}
+        for key, val in state.arrays.items():
+            if key == "scores":
+                arrays[key] = np.asarray(val)[:, start:stop]
+            elif key == "select":
+                arrays[key] = np.asarray(val)[start:stop]
+            elif key.startswith("valid_scores_"):
+                i = int(key[len("valid_scores_"):])
+                vs = sum(valid_shard_rows[i][:rank])
+                ve = vs + valid_shard_rows[i][rank]
+                arrays[key] = np.asarray(val)[:, vs:ve]
+            elif key.startswith("bag_rng_keys_r"):
+                continue  # per-rank streams, resolved below
+            else:
+                arrays[key] = val
+        py = {k: v for k, v in state.py.items() if k != "per_rank"}
+        if same_partition:
+            pr = (state.py.get("per_rank") or {}).get(str(rank))
+            if pr is not None:
+                py = dict(pr["py"])
+                meta["best_iteration"] = int(pr.get("best_iteration", -1))
+            arrays["bag_rng_keys"] = np.asarray(
+                state.arrays[f"bag_rng_keys_r{rank}"], np.uint32)
+        else:
+            rs = np.random.RandomState([
+                int(bag_seed) & 0xFFFFFFFF,
+                int(meta["iteration"]) & 0xFFFFFFFF,
+                int(rank),
+            ])
+            st = rs.get_state()
+            arrays["bag_rng_keys"] = np.asarray(st[1], np.uint32)
+            py["bag_rng"] = [str(st[0]), int(st[2]), int(st[3]),
+                             float(st[4])]
+            py["need_re_bagging"] = True
+        meta["num_data"] = shard_rows[rank]
+        meta["data_fingerprint"] = local_fp
+        for key in ("world_size", "shard_rows", "valid_shard_rows"):
+            meta.pop(key, None)
+    return TrainState(meta, py, arrays)

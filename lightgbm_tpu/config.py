@@ -274,6 +274,17 @@ class Config:
     network_timeout: float = 120.0
     network_retries: int = 3
     network_heartbeat_interval: float = 0.0
+    # --- straggler-aware shard rebalancing (parallel/shardplan.py;
+    # docs/ROBUSTNESS.md).  Off by default: rebalance=False keeps the
+    # exact static-shard behavior (zero extra collectives).  When on, a
+    # rank whose EWMA compute time stays above rebalance_threshold x the
+    # fleet median for rebalance_patience consecutive iterations
+    # triggers a shard-boundary move at the next iteration boundary; at
+    # most rebalance_max_move_frac of the global rows move per event.
+    rebalance: bool = False
+    rebalance_threshold: float = 1.5
+    rebalance_patience: int = 3
+    rebalance_max_move_frac: float = 0.25
 
     # --- derived
     is_parallel: bool = False
@@ -376,6 +387,15 @@ class Config:
             Log.fatal("network_timeout must be > 0, got %s", self.network_timeout)
         if self.network_retries < 0:
             Log.fatal("network_retries must be >= 0, got %d", self.network_retries)
+        if self.rebalance_threshold <= 1.0:
+            Log.fatal("rebalance_threshold must be > 1, got %s",
+                      self.rebalance_threshold)
+        if self.rebalance_patience < 1:
+            Log.fatal("rebalance_patience must be >= 1, got %d",
+                      self.rebalance_patience)
+        if not (0.0 < self.rebalance_max_move_frac <= 1.0):
+            Log.fatal("rebalance_max_move_frac must be in (0, 1], got %s",
+                      self.rebalance_max_move_frac)
         Log.reset_level(self.verbose)
 
 

@@ -51,7 +51,13 @@ def train(
     training-state checkpoints every ``checkpoint_freq`` iterations.
     ``checkpoint_resume`` is ``"auto"`` (resume only an interrupted
     run), ``False`` (never), or ``"force"`` (require a checkpoint).
-    A resumed run is bit-identical to one that never died."""
+    A resumed run is bit-identical to one that never died.  Multihost
+    checkpoints are saved in a canonical topology-free layout, so a
+    run may resume on a *different* world size (elastic resume — same
+    world stays byte-identical; a resized fleet reshards and continues
+    from the same iteration).  ``rebalance=True`` additionally lets a
+    data-parallel fleet shift shard boundaries off a persistently slow
+    host at iteration boundaries (docs/ROBUSTNESS.md)."""
     tracer.refresh_from_env()  # LIGHTGBM_TPU_TRACE=trace.jsonl
     audit.refresh_from_env()   # LIGHTGBM_TPU_AUDIT=audit.jsonl
     params = dict(params or {})

@@ -428,6 +428,33 @@ def merge_summary(by_rank: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
                 sum(per_rank[r]["barrier_wait_s"]
                     for r in ranks if r != straggler), 6),
         }
+    # shard-rebalance events (rebalance.plan, boosting/gbdt.py): per-rank
+    # rows owned before/after each move, plus the fleet barrier-wait
+    # share on either side of it — did the move actually reclaim wait?
+    # Every rank emits the identical event; dedupe on the iteration.
+    events: Dict[int, Dict[str, Any]] = {}
+    for recs in by_rank.values():
+        for r in recs:
+            if r.get("ev") == "event" and r.get("name") == "rebalance.plan":
+                events.setdefault(int(r.get("iter", -1)), r)
+    if events:
+        def _wait_share(its):
+            wall = sum(iters[r][it]["wall_s"] for r in ranks for it in its)
+            wait = sum(iters[r][it]["wait_s"] for r in ranks for it in its)
+            return round(wait / wall, 4) if wall > 0 else None
+
+        out["rebalance"] = []
+        for ev_it in sorted(events):
+            ev = events[ev_it]
+            out["rebalance"].append({
+                "iter": ev_it,
+                "rows_before": [int(c) for c in ev.get("before", [])],
+                "rows_after": [int(c) for c in ev.get("after", [])],
+                "wait_share_before": _wait_share(
+                    [it for it in common if it < ev_it]),
+                "wait_share_after": _wait_share(
+                    [it for it in common if it >= ev_it]),
+            })
     return out
 
 
@@ -465,6 +492,18 @@ def render_merge(m: Dict[str, Any]) -> str:
             f"{st['slowest_in_iters']}/{m['aligned_iterations']} "
             f"iteration(s); other ranks spent "
             f"{st['wait_behind_straggler_s']:.3f} s in barrier wait")
+    if m.get("rebalance"):
+        lines.append("")
+        lines.append(f"{'rebalance':<14}{'rows/rank before -> after':<40}"
+                     f"{'wait share':>14}")
+        for ev in m["rebalance"]:
+            wb, wa = ev["wait_share_before"], ev["wait_share_after"]
+            trend = (f"{wb:.2f} -> {wa:.2f}"
+                     if wb is not None and wa is not None else "n/a")
+            lines.append(
+                f"{'@ iter ' + str(ev['iter']):<14}"
+                f"{str(ev['rows_before']) + ' -> ' + str(ev['rows_after']):<40}"
+                f"{trend:>14}")
     if m["phases"]:
         lines.append("")
         header = f"{'phase':<24}" + "".join(f"rank{r:>2}/s{'':>3}"

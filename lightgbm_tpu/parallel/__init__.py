@@ -9,6 +9,7 @@ from .comm import LocalComm, LocalGroup, NetComm
 from .hostlearner import HostParallelLearner
 from .learner import ShardedLearner, make_mesh
 from .net import CollectiveTimeoutError, NetError, PeerFailureError
+from .shardplan import RebalanceController, ShardPlan, exchange_rows
 
 __all__ = [
     "ShardedLearner",
@@ -20,4 +21,7 @@ __all__ = [
     "NetError",
     "PeerFailureError",
     "CollectiveTimeoutError",
+    "ShardPlan",
+    "RebalanceController",
+    "exchange_rows",
 ]

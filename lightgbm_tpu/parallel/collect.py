@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import itertools
 import pickle
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -95,10 +96,19 @@ def allgather_bytes(blob: bytes, purpose: str = "misc") -> List[bytes]:
                    transport=transport)
     with tracer.span("net.allgather", transport=transport, bytes=len(blob),
                      purpose=purpose):
-        if transport == "kv":
-            # XLA:CPU has no multi-process computations; use the KV store
-            return _kv_allgather(blob)
-        return _array_allgather(blob)
+        # time the transport only (after fault_point, so an injected
+        # straggler stall counts as the straggler's own compute while
+        # its peers book the stall here as wait — the signal the
+        # rebalance controller feeds on)
+        t0 = time.perf_counter()
+        try:
+            if transport == "kv":
+                # XLA:CPU has no multi-process computations; use the KV
+                # store
+                return _kv_allgather(blob)
+            return _array_allgather(blob)
+        finally:
+            net.wait_clock_add(time.perf_counter() - t0)
 
 
 def barrier(tag: str = "barrier") -> None:

@@ -196,6 +196,14 @@ class HostParallelLearner:
         self._qiter = -1  # per-grow stochastic-rounding key counter
         self._qscales = None  # (2,) np.float32 scales of the current tree
 
+    def set_plan(self, plan) -> None:
+        """Shard-plan seam (parallel/shardplan.py): the host-driven
+        learner is stateless with respect to rows (bins/grad/hess arrive
+        per grow call and jit caches are shape-keyed), so a row-ownership
+        move needs no invalidation here — the seam exists so the driver
+        can treat every parallel learner uniformly."""
+        del plan
+
     # -- helpers ------------------------------------------------------
 
     def _feature_block(self, f: int):

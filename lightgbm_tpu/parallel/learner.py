@@ -116,6 +116,16 @@ class ShardedLearner:
         self._global_bins = None  # cached assembled bins + gmax (multi-process)
 
     # ------------------------------------------------------------------
+    def set_plan(self, plan) -> None:
+        """Shard-plan seam (parallel/shardplan.py): row ownership moved,
+        so the cached assembled global bins and the allgathered max row
+        count are stale — drop them; the next grow reassembles from the
+        new shards (shape-keyed jit recompiles automatically)."""
+        del plan  # ownership is implicit in the arrays each rank passes
+        self._global_bins = None
+        self._gmax = None
+
+    # ------------------------------------------------------------------
     def grow(self, bins, grad, hess, select, feature_mask, meta, hyper,
              qscale=None) -> GrowResult:
         """Grow one tree.  In a multi-process runtime each process passes

@@ -33,9 +33,16 @@ that missing layer:
   log, so survivors must bypass interpreter exit.  ``task=train``
   auto-resume then restores bit-identically (docs/ROBUSTNESS.md).
 - **Fault injection.**  ``LIGHTGBM_TPU_FAULT=die:N|drop_collective:N|
-  delay:ms`` (optionally gated by ``LIGHTGBM_TPU_FAULT_RANK``) is
-  checked at every hardened collective, so kill/hang scenarios are
-  testable on a real subprocess matrix (tests/test_net_fault.py).
+  delay:ms|delay:ms:after:N`` (optionally gated by
+  ``LIGHTGBM_TPU_FAULT_RANK``) is checked at every hardened collective,
+  so kill/hang/straggler scenarios are testable on a real subprocess
+  matrix (tests/test_net_fault.py).  The ``after:N`` form arms the
+  per-collective slowdown only from the N-th call on, so a rank can
+  *become* a straggler mid-run; :func:`set_delay_scale` scales every
+  injected delay multiplicatively (the GBDT driver ties it to the
+  rank's current/initial row-count ratio, modeling a host whose
+  per-row compute is slow — so shard rebalancing measurably shrinks
+  the injected straggler's iteration time, docs/ROBUSTNESS.md).
 """
 
 from __future__ import annotations
@@ -195,12 +202,15 @@ def configure_from_config(config) -> NetSettings:
 
 def _reset_for_tests() -> None:
     """Drop cached settings/fault state so env changes take effect."""
-    global _settings, _fault_specs, _fault_calls
+    global _settings, _fault_specs, _fault_calls, _delay_scale, _wait_clock_s
     with _settings_lock:
         _settings = None
     with _fault_lock:
         _fault_specs = None
         _fault_calls = 0
+    _delay_scale = 1.0
+    with _wait_clock_lock:
+        _wait_clock_s = 0.0
     _chunks_written.clear()
 
 
@@ -252,24 +262,82 @@ def retry_call(fn: Callable, what: str, retries: Optional[int] = None,
 # ----------------------------------------------------------------------
 # fault injection (tests / chaos drills)
 # ----------------------------------------------------------------------
-_fault_specs: Optional[List[Tuple[str, float]]] = None
+_fault_specs: Optional[List[Tuple]] = None
 _fault_calls = 0
 _fault_lock = threading.Lock()
+# multiplicative scale on every injected delay sleep.  The GBDT driver
+# sets it to (current local rows / initial local rows) under a
+# row-sharded learner, so an injected per-collective slowdown models a
+# host whose PER-ROW compute is slow: moving rows off the straggler
+# shrinks its injected stall proportionally, making shard rebalancing
+# measurable on CPU (bench.py elastic section).
+_delay_scale = 1.0
 
 
-def parse_fault_spec(spec: str) -> List[Tuple[str, float]]:
-    """``die:N | drop_collective:N | delay:ms`` (comma-separable).
-    ``N`` is the 1-based hardened-collective call index; ``ms`` applies
-    to every call."""
-    out: List[Tuple[str, float]] = []
+def set_delay_scale(scale: float) -> None:
+    """Scale injected ``delay`` fault sleeps (no-op without faults)."""
+    global _delay_scale
+    _delay_scale = max(float(scale), 0.0)
+
+
+def delay_scale() -> float:
+    return _delay_scale
+
+
+# Cross-host wait time spent inside collective transports this interval.
+# collect.allgather_bytes feeds it (transport call only, *after* the
+# fault_point so injected straggler stalls land on the straggler's own
+# compute side); the rebalance controller drains it once per iteration.
+_wait_clock_s = 0.0
+_wait_clock_lock = threading.Lock()
+
+
+def wait_clock_add(seconds: float) -> None:
+    """Accumulate collective-transport wait time (rebalance signal)."""
+    global _wait_clock_s
+    with _wait_clock_lock:
+        _wait_clock_s += max(float(seconds), 0.0)
+
+
+def wait_clock_drain() -> float:
+    """Return accumulated transport wait seconds and reset to zero."""
+    global _wait_clock_s
+    with _wait_clock_lock:
+        out = _wait_clock_s
+        _wait_clock_s = 0.0
+    return out
+
+
+def parse_fault_spec(spec: str) -> List[Tuple]:
+    """``die:N | drop_collective:N | delay:ms | delay:ms:after:N``
+    (comma-separable).  ``N`` is the 1-based hardened-collective call
+    index; a bare ``delay:ms`` applies to every call, while
+    ``delay:ms:after:N`` arms the persistent slowdown only from call N
+    on (a rank that becomes a straggler mid-run)."""
+    out: List[Tuple] = []
     for part in spec.split(","):
         part = part.strip()
         if not part:
             continue
-        kind, _, arg = part.partition(":")
-        kind = kind.strip().lower()
+        fields = part.split(":")
+        kind = fields[0].strip().lower()
         if kind not in ("die", "drop_collective", "delay"):
             raise ValueError(f"unknown fault kind {kind!r} in {spec!r}")
+        if (kind == "delay" and len(fields) == 4
+                and fields[2].strip().lower() == "after"):
+            try:
+                ms, after = float(fields[1]), float(fields[3])
+            except ValueError:
+                raise ValueError(f"bad fault argument in {part!r}")
+            if after < 1:
+                raise ValueError(
+                    f"delay:ms:after:N needs a 1-based call index, "
+                    f"got {part!r}")
+            out.append(("delay_after", ms, after))
+            continue
+        if len(fields) > 2:
+            raise ValueError(f"bad fault argument in {part!r}")
+        arg = fields[1] if len(fields) > 1 else ""
         try:
             val = float(arg) if arg else 0.0
         except ValueError:
@@ -308,9 +376,12 @@ def fault_point(kind: str = "collective") -> None:
             return
         _fault_calls += 1
         calls = _fault_calls
-    for fkind, arg in _fault_specs:
+    for spec_item in _fault_specs:
+        fkind, arg = spec_item[0], spec_item[1]
         if fkind == "delay":
-            time.sleep(arg / 1e3)
+            time.sleep(arg / 1e3 * _delay_scale)
+        elif fkind == "delay_after" and calls >= int(spec_item[2]):
+            time.sleep(arg / 1e3 * _delay_scale)
         elif fkind == "die" and calls == int(arg):
             Log.warning("FAULT INJECTION: die at %s call %d", kind, calls)
             sys.stdout.flush()

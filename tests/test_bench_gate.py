@@ -303,6 +303,60 @@ def test_comms_payload_gate_passes(tmp_path):
         assert not k.startswith("regression"), k
 
 
+def _elastic(recovery=2.5):
+    return {
+        "rows": 1024, "trees": 14, "ranks": 2,
+        "delay_ms_per_collective": 30,
+        "no_straggler_s_per_iter": 0.16,
+        "straggler_off_s_per_iter": 1.5,
+        "straggler_rebalance_s_per_iter": round(1.5 / recovery, 4),
+        "straggler_slowdown": 9.2,
+        "recovery_ratio": recovery,
+        "final_counts": [154, 870],
+    }
+
+
+def test_elastic_gate_fires_without_prior(tmp_path):
+    """Rebalance-on must beat rebalance-off >=1.3x under the injected
+    straggler; the stall dominates on any backend, so the leg gates
+    outright with no prior capture."""
+    out = {"metric": METRIC, "value": 0.10, "elastic": _elastic(recovery=1.1)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 1
+    assert out["regression_elastic_recovery"] is True
+    assert out["gate_elastic"]["min_recovery_ratio"] == 1.3
+    assert out["gate_elastic"]["recovery_ratio"] == pytest.approx(1.1)
+
+
+def test_elastic_gate_is_device_independent(tmp_path):
+    # the recovery ratio gates even on a backend_fallback capture that
+    # skips every wall-clock gate (CPU fallback included, by contract)
+    out = {"metric": METRIC, "value": 9.9, "backend_fallback": True,
+           "elastic": _elastic(recovery=1.2)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 1
+    assert out["regression_elastic_recovery"] is True
+    assert "regression" not in out  # headline leg still skipped
+    out = {"metric": METRIC, "value": 9.9, "backend_fallback": True,
+           "elastic": _elastic(recovery=2.7)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+    assert "gate_elastic" in out
+
+
+def test_elastic_gate_passes(tmp_path):
+    out = {"metric": METRIC, "value": 0.10, "elastic": _elastic(recovery=2.67)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+    assert out["gate_elastic"]["recovery_ratio"] == pytest.approx(2.67)
+    for k in list(out):
+        assert not k.startswith("regression"), k
+
+
+def test_elastic_section_error_never_gates(tmp_path):
+    out = {"metric": METRIC, "value": 0.10,
+           "elastic": {"error": "RuntimeError: fleet failed"}}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+    assert "gate_elastic" not in out
+    assert "regression_elastic_recovery" not in out
+
+
 def test_comms_wall_gate_against_prior(tmp_path):
     _capture(tmp_path, "BENCH_r01.json", 0.10, comms=_comms(data_s=1.0))
     out = {"metric": METRIC, "value": 0.10,

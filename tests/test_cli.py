@@ -11,7 +11,6 @@ import sys
 import numpy as np
 import pytest
 
-EXAMPLES = "/root/reference/examples"
 ENV = dict(
     os.environ,
     JAX_PLATFORMS="cpu",
@@ -27,11 +26,11 @@ def _run_cli(args, cwd):
 
 
 @pytest.fixture(scope="module")
-def regression_dir(tmp_path_factory):
+def regression_dir(tmp_path_factory, reference_examples):
     """Copy of examples/regression (the originals are read-only)."""
     dst = tmp_path_factory.mktemp("regression_example")
     for name in ("train.conf", "predict.conf", "regression.train", "regression.test"):
-        shutil.copy(f"{EXAMPLES}/regression/{name}", dst)
+        shutil.copy(f"{reference_examples}/regression/{name}", dst)
     return str(dst)
 
 

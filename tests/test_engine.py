@@ -12,8 +12,6 @@ import pytest
 
 import lightgbm_tpu as lgb
 
-EXAMPLES = "/root/reference/examples"
-
 
 def _load(path):
     d = np.loadtxt(path)
@@ -21,9 +19,9 @@ def _load(path):
 
 
 @pytest.fixture(scope="module")
-def regression_data():
-    X, y = _load(f"{EXAMPLES}/regression/regression.train")
-    Xt, yt = _load(f"{EXAMPLES}/regression/regression.test")
+def regression_data(reference_examples):
+    X, y = _load(f"{reference_examples}/regression/regression.train")
+    Xt, yt = _load(f"{reference_examples}/regression/regression.test")
     return X, y, Xt, yt
 
 
@@ -40,11 +38,11 @@ def binary_data():
 
 
 @pytest.fixture(scope="module")
-def binary_example_data():
+def binary_example_data(reference_examples):
     """The checked-in examples/binary_classification fixtures (a harder,
     Higgs-like dataset used by the reference's CLI tests)."""
-    X, y = _load(f"{EXAMPLES}/binary_classification/binary.train")
-    Xt, yt = _load(f"{EXAMPLES}/binary_classification/binary.test")
+    X, y = _load(f"{reference_examples}/binary_classification/binary.train")
+    Xt, yt = _load(f"{reference_examples}/binary_classification/binary.test")
     return X, y, Xt, yt
 
 
@@ -146,15 +144,15 @@ def test_multiclass():
     assert evals_result["valid_0"]["multi_logloss"][-1] < 0.2
 
 
-def test_lambdarank():
+def test_lambdarank(reference_examples):
     """Reference test_sklearn.py:55 lambdarank on examples data (LibSVM
     format, loaded through the parser)."""
     from lightgbm_tpu.io.parser import _load_libsvm
 
-    X, y = _load_libsvm(f"{EXAMPLES}/lambdarank/rank.train")
-    group = np.loadtxt(f"{EXAMPLES}/lambdarank/rank.train.query")
-    Xt, yt = _load_libsvm(f"{EXAMPLES}/lambdarank/rank.test")
-    gt = np.loadtxt(f"{EXAMPLES}/lambdarank/rank.test.query")
+    X, y = _load_libsvm(f"{reference_examples}/lambdarank/rank.train")
+    group = np.loadtxt(f"{reference_examples}/lambdarank/rank.train.query")
+    Xt, yt = _load_libsvm(f"{reference_examples}/lambdarank/rank.test")
+    gt = np.loadtxt(f"{reference_examples}/lambdarank/rank.test.query")
     if Xt.shape[1] < X.shape[1]:
         Xt = np.hstack([Xt, np.zeros((Xt.shape[0], X.shape[1] - Xt.shape[1]))])
     params = {"objective": "lambdarank", "metric": "ndcg",
@@ -369,9 +367,9 @@ def test_custom_objective(regression_data):
     assert mse < 16
 
 
-def test_weighted_training(binary_example_data):
+def test_weighted_training(binary_example_data, reference_examples):
     X, y, Xt, yt = binary_example_data
-    w = np.loadtxt(f"{EXAMPLES}/binary_classification/binary.train.weight")
+    w = np.loadtxt(f"{reference_examples}/binary_classification/binary.train.weight")
     ds = lgb.Dataset(X, label=y, weight=w)
     bst = lgb.train({"objective": "binary", "verbose": -1}, ds,
                     num_boost_round=20, verbose_eval=False)

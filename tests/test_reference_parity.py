@@ -105,7 +105,7 @@ def _test_path(name):
 
 
 @pytest.mark.parametrize("name", list(TASKS))
-def test_reference_model_cross_load_predict_parity(name):
+def test_reference_model_cross_load_predict_parity(name, reference_examples):
     """Load the reference-trained model file; our traversal must emit the
     reference's own predictions (same transform incl. sigmoid/softmax)."""
     bst = lgb.Booster(model_file=os.path.join(GOLD, f"{name}_model.txt"))
@@ -116,7 +116,7 @@ def test_reference_model_cross_load_predict_parity(name):
 
 
 @pytest.mark.parametrize("name", list(TASKS))
-def test_train_metric_parity_vs_reference(name):
+def test_train_metric_parity_vs_reference(name, reference_examples):
     """Sampling-free training here must land on the reference's final
     valid metric within the published CPU↔GPU tolerance band."""
     d, train, test, params = TASKS[name]
@@ -173,7 +173,7 @@ def ref_bin():
 
 
 @pytest.mark.parametrize("name", list(TASKS))
-def test_our_model_loads_into_reference_binary(name, ref_bin):
+def test_our_model_loads_into_reference_binary(name, reference_examples, ref_bin):
     """Reverse direction: a model we save must be consumable by the
     reference binary's task=predict, and its predictions must match ours."""
     d, train, test, params = TASKS[name]
