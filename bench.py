@@ -1639,6 +1639,23 @@ def main():
                 os.environ["LIGHTGBM_TPU_TRACE_PHASES"] = phases_before
                 tracer._phases_env = phases_before
 
+    # ---- xprof capture (LIGHTGBM_TPU_XPROF=dir): bounded device-
+    # profiler window over a few already-warm iterations, after the
+    # timed windows so the profiler overhead cannot touch s/iter ----
+    from lightgbm_tpu.utils.profiling import maybe_xprof_capture
+
+    xprof = maybe_xprof_capture()
+    xprof_info = None
+    if xprof is not None:
+        xprof.skip = 0  # the timed windows above already warmed up
+        for _ in range(xprof.iters):
+            xprof.on_iter_start()
+            run_iters(1)
+            xprof.on_iter_end()
+        xprof.close()
+        total_iters += xprof.iters
+        xprof_info = {"dir": xprof.log_dir, "iters": xprof.iters}
+
     # ---- quality signal on held-out rows of the SAME task ----
     prob = booster.predict(Xt)
     auc = _auc(yt, prob)
@@ -1688,6 +1705,8 @@ def main():
     if backend_fallback:
         out["backend_fallback"] = True
         out["device_tunnel_dead"] = True
+    if xprof_info is not None:
+        out["xprof"] = xprof_info
 
     # same-box measured CPU baseline (refbuild/measure_baseline.py writes
     # it into BASELINE.json "published"); the GPU number above remains
@@ -1831,6 +1850,21 @@ def main():
             out["metrics_path"] = metrics_path
         except OSError:
             pass
+        # HLO cost model (obs/costmodel.py): the jax_cost program
+        # inventory joined against the measured phase spans — per-phase
+        # efficiency vs the roofline, and the machine-picked next
+        # kernel target (the line ROADMAP item 1 asks every capture to
+        # end with)
+        from lightgbm_tpu.obs import costmodel
+
+        cm = costmodel.process_summary()
+        out["cost_model"] = cm
+        for row in cm["table"]:
+            if row.get("efficiency_pct") is not None:
+                tracer.gauge("cost.efficiency_pct", row["efficiency_pct"],
+                             phase=row["phase"], program=row["program"])
+        if cm.get("next_target_line"):
+            print("# " + cm["next_target_line"], file=sys.stderr)
 
     # device memory footprint (validates the no-scratch-copy design at
     # Higgs scale; axon may not expose memory_stats — best-effort)

@@ -533,6 +533,7 @@ class PartitionedTrainer:
             self._progs[pkey] = JitWatch(
                 self._build_program(alloc, bag_on, bag_freq, used_features),
                 name=f"ptrainer.chunk(bag={int(bag_on)},ff={used_features})",
+                phase="chunk_program",
             )
         prog = self._progs[pkey]
         recs_np = None
@@ -659,11 +660,18 @@ class PartitionedTrainer:
                 p, jnp.take(p[:, :n], inv, axis=1), (0, 0))
             return p, lt
 
+        # phase= maps each program onto the measured span it runs under
+        # (obs/costmodel.py joins HLO rooflines against those spans);
+        # canon has no span of its own
         return {
-            "update": JitWatch(upd, name="ptrainer.traced.update"),
-            "partition": JitWatch(part, name="ptrainer.traced.partition"),
-            "find": JitWatch(find, name="ptrainer.traced.find"),
-            "score": JitWatch(score, name="ptrainer.traced.score"),
+            "update": JitWatch(upd, name="ptrainer.traced.update",
+                               phase="histogram"),
+            "partition": JitWatch(part, name="ptrainer.traced.partition",
+                                  phase="partition"),
+            "find": JitWatch(find, name="ptrainer.traced.find",
+                             phase="split"),
+            "score": JitWatch(score, name="ptrainer.traced.score",
+                              phase="score_update"),
             "canon": JitWatch(canon, name="ptrainer.traced.canon"),
         }
 
@@ -1444,6 +1452,7 @@ class ShardedPartitionedTrainer(PartitionedTrainer):
             self._progs[pkey] = JitWatch(
                 self._build_program(alloc, bag_on, bag_freq, used_features),
                 name=f"ptrainer.sharded_chunk(bag={int(bag_on)},ff={used_features})",
+                phase="chunk_program",
             )
         prog = self._progs[pkey]
         recs_np = None

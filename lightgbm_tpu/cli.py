@@ -136,11 +136,21 @@ def run_train(config: Config, params: Dict[str, str]) -> None:
                 Log.info("Resuming training from checkpoint at iteration %d",
                          start_iter)
 
+    # LIGHTGBM_TPU_XPROF=<dir>: bounded device-profiler capture across a
+    # few steady-state iterations (utils/profiling.XprofCapture) — the
+    # ROADMAP recapture sweep needs only the env var, no code
+    from .utils.profiling import maybe_xprof_capture
+
+    xprof = maybe_xprof_capture()
     Log.info("Started training...")
     try:
         for it in range(start_iter, num_iters):
             start = time.time()
+            if xprof is not None:
+                xprof.on_iter_start()
             finished = b.train_one_iter(is_eval=True)
+            if xprof is not None:
+                xprof.on_iter_end()
             Log.info("%f seconds elapsed, finished iteration %d",
                      time.time() - start, it + 1)
             if config.snapshot_freq > 0 and (it + 1) % config.snapshot_freq == 0:
@@ -168,6 +178,9 @@ def run_train(config: Config, params: Dict[str, str]) -> None:
         if mgr is not None:
             mgr.flush()
         raise
+    finally:
+        if xprof is not None:
+            xprof.close()
     if mgr is not None:
         mgr.mark_complete(booster)
         mgr.close()

@@ -9,10 +9,13 @@ Import surface (kept tiny — hot paths touch only ``tracer``/``fence``):
   with tracer.iteration(i) as rec: rec["leaves"] = 31
 
 Submodules: ``trace`` (spans/counters/gauges/iteration records, JSONL
-sink), ``compilewatch`` (jax.monitoring compile counter + JitWatch
-retrace detector), ``memory`` (host/device gauges), ``report``
-(aggregation + the ``python -m lightgbm_tpu report`` CLI, incl. the
-cross-rank ``merge`` and audit ``diff`` subcommands), ``metrics``
+sink with LIGHTGBM_TPU_TRACE_MAX_MB rotation), ``compilewatch``
+(jax.monitoring compile counter + JitWatch retrace detector + the
+first-compile HLO cost capture), ``costmodel`` (per-program flops/bytes
+inventory, peak-spec roofline, per-phase efficiency attribution),
+``memory`` (host/device gauges), ``report`` (aggregation + the
+``python -m lightgbm_tpu report`` CLI, incl. the cross-rank ``merge``,
+audit ``diff``, ``costs`` and ``bench-trend`` subcommands), ``metrics``
 (Prometheus text-format registry behind ``GET /metrics``), ``audit``
 (LIGHTGBM_TPU_AUDIT split-decision trail), ``flight`` (crash flight
 recorder dumping to ``<trace>.crash.jsonl``).

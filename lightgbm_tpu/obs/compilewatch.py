@@ -99,13 +99,19 @@ def _sig_of(args, kwargs):
 
 class JitWatch:
     """Wrap a jitted callable; count compilations per array signature and
-    flag cache growth on an already-seen signature as a retrace."""
+    flag cache growth on an already-seen signature as a retrace.
 
-    def __init__(self, fn, name: str):
+    ``phase`` tags the program with the measured phase-span name it
+    accounts under (``histogram``, ``chunk_program``, ``serve_batch``,
+    ...) so the cost model (obs/costmodel.py) can join its HLO roofline
+    against the wall-clock the trace measured for that phase."""
+
+    def __init__(self, fn, name: str, phase: str = None):
         import threading
 
         self._fn = fn
         self.name = name
+        self.phase = phase
         self.calls = 0
         self.compiles = 0
         self.retraces = 0
@@ -128,6 +134,15 @@ class JitWatch:
             return None
 
     def __call__(self, *args, **kwargs):
+        from jax.core import trace_state_clean
+
+        # called while an OUTER jit is tracing: this program is inlined
+        # into the caller's jaxpr — no backend compile happens here, and
+        # the cache bookkeeping below would misread the outer trace's
+        # state.  Call straight through (the module-level kernel watches
+        # in ops/pgrow.py and ops/histogram.py hit this constantly).
+        if not trace_state_clean():
+            return self._fn(*args, **kwargs)
         with self._lock:
             return self._call_locked(args, kwargs)
 
@@ -141,6 +156,7 @@ class JitWatch:
         # whole re-warm as retraces
         if before is not None and before < self._last_cache_size:
             self._sigs.clear()
+        csecs0 = _counts["backend_compile_secs"]
         out = self._fn(*args, **kwargs)
         if before is None:
             return out
@@ -166,4 +182,24 @@ class JitWatch:
             else:
                 self._sigs.add(sig)
                 tracer.event("jax_trace", fn=self.name, cache_size=after)
+                self._record_cost(
+                    args, kwargs,
+                    _counts["backend_compile_secs"] - csecs0, sig)
         return out
+
+    def _record_cost(self, args, kwargs, compile_secs, sig=None):
+        """First compile per signature: scrape HLO cost/memory analysis
+        into the program inventory + a ``jax_cost`` trace record
+        (obs/costmodel.py).  Only when tracing is enabled (the capture
+        re-lowers the program once — not free), and never allowed to
+        break the training step."""
+        from .trace import tracer
+
+        if not tracer.enabled:
+            return
+        try:
+            from . import costmodel
+
+            costmodel.capture(self, args, kwargs, compile_secs, sig=sig)
+        except Exception as e:
+            Log.warning("cost capture failed for %s: %s", self.name, e)

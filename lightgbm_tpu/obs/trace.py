@@ -55,6 +55,19 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 
+def _max_bytes_from_env() -> int:
+    """LIGHTGBM_TPU_TRACE_MAX_MB as a byte cap (0/unset/garbage = no
+    rotation — the historical unbounded behavior)."""
+    raw = os.environ.get("LIGHTGBM_TPU_TRACE_MAX_MB", "").strip()
+    if not raw:
+        return 0
+    try:
+        mb = float(raw)
+    except ValueError:
+        return 0
+    return int(mb * 1024 * 1024) if mb > 0 else 0
+
+
 def _flight_recorder():
     """Lazy accessor for the crash flight recorder (obs/flight.py) —
     imported on first enabled-mode emit, cached after."""
@@ -113,6 +126,13 @@ class Tracer:
         self.enabled = False
         self.path: Optional[str] = None
         self._f = None
+        # JSONL rotation: bytes written to the current sink file and the
+        # LIGHTGBM_TPU_TRACE_MAX_MB cap (0 = unbounded).  At the cap the
+        # sink rotates to <path>.1 (one generation — a bounded factory
+        # run keeps at most 2x the cap on disk) and report loaders read
+        # the <path>.1 + <path> pair in order.
+        self._bytes = 0
+        self._max_bytes = 0
         self._lock = threading.Lock()
         self._stack = []
         self._agg: Dict[str, list] = {}
@@ -140,6 +160,7 @@ class Tracer:
         tracing without importing this module early."""
         self._phases_env = os.environ.get("LIGHTGBM_TPU_TRACE_PHASES", "")
         self._ident_from_env()
+        self._max_bytes = _max_bytes_from_env()
         path = os.environ.get("LIGHTGBM_TPU_TRACE", "")
         if path and path != self.path:
             self.configure(path)
@@ -177,6 +198,8 @@ class Tracer:
         if d:
             os.makedirs(d, exist_ok=True)
         self._f = open(path, "w", buffering=1)  # line buffered
+        self._bytes = 0
+        self._max_bytes = _max_bytes_from_env()
         self.enabled = True
         from . import compilewatch, flight
 
@@ -238,6 +261,29 @@ class Tracer:
         with self._lock:
             if self._f is not None:
                 self._f.write(line + "\n")
+                self._bytes += len(line) + 1
+                if self._max_bytes and self._bytes >= self._max_bytes:
+                    self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Size-capped sink rotation (caller holds ``_lock``): the
+        current file becomes ``<path>.1`` (clobbering any previous
+        generation) and a fresh sink opens at ``path`` with a new meta
+        record so the rotated pair is self-describing."""
+        try:
+            self._f.flush()
+            self._f.close()
+            os.replace(self.path, self.path + ".1")
+        except OSError:  # pragma: no cover - exotic fs; keep tracing
+            pass
+        self._f = open(self.path, "w", buffering=1)
+        self._bytes = 0
+        meta = {"ev": "meta", "version": 1, "pid": os.getpid(),
+                "rotated": True, "ts": round(time.time(), 6)}
+        meta.update(self._ident)
+        line = json.dumps(meta)
+        self._f.write(line + "\n")
+        self._bytes += len(line) + 1
 
     def span(self, name: str, **attrs):
         """Timed nested span context manager (no-op singleton when

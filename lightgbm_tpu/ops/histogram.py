@@ -33,6 +33,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..obs.compilewatch import JitWatch
+
 # Rows per block in the blocked one-hot contraction. 4096 keeps the
 # bf16 one-hot tile (ROW_BLOCK x F*B) comfortably inside VMEM after XLA
 # tiling while amortizing loop overhead.
@@ -140,6 +142,13 @@ def build_histogram(
         init = jnp.zeros((f, num_bins, 3), dtype=acc_dtype)
     hist, _ = jax.lax.scan(body, init, (bins_b, vals_b))
     return hist
+
+
+# compile/retrace + HLO cost accounting on the standalone kernel entry
+# (obs/compilewatch.py): calls made while an outer jit traces (the fused
+# chunk programs inline this) pass straight through the watch
+build_histogram = JitWatch(build_histogram, "ops.build_histogram",
+                           phase="histogram")
 
 
 def accumulate_histogram(

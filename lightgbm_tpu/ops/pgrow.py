@@ -38,6 +38,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..obs.compilewatch import JitWatch
 from .histogram_pallas import hist_segments
 from .pkernels import (
     BLK,
@@ -558,6 +559,13 @@ def grow_tree_partitioned(
         rec_internal_value=recs[:, 9],
     )
     return res, st.p
+
+
+# compile/retrace + HLO cost accounting on the standalone grower entry
+# (obs/compilewatch.py): when the fused chunk programs trace this
+# inline, the call passes straight through the watch
+grow_tree_partitioned = JitWatch(grow_tree_partitioned,
+                                 "ops.grow_tree_partitioned", phase="tree")
 
 
 def level_hists(p, seg_tab, n_active, params: PGrowParams, rows=None,

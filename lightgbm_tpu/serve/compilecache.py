@@ -75,7 +75,8 @@ _watched_qpredict: Optional[JitWatch] = None
 def _watch() -> JitWatch:
     global _watched_predict_raw
     if _watched_predict_raw is None:
-        _watched_predict_raw = JitWatch(predict_raw, "serve.predict_raw")
+        _watched_predict_raw = JitWatch(predict_raw, "serve.predict_raw",
+                                        phase="serve_batch")
     return _watched_predict_raw
 
 
@@ -84,7 +85,8 @@ def _qwatch() -> JitWatch:
     if _watched_qpredict is None:
         from ..ops.qpredict import qpredict_raw
 
-        _watched_qpredict = JitWatch(qpredict_raw, "serve.qpredict")
+        _watched_qpredict = JitWatch(qpredict_raw, "serve.qpredict",
+                                     phase="serve_batch")
     return _watched_qpredict
 
 

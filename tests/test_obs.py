@@ -686,6 +686,16 @@ class TestNameRegistryLint:
     METRIC_PAT = re.compile(
         r'(?:registry|reg)\.(?:labeled_)?(?:counter|gauge|histogram)\(\s*\n?\s*'
         r'[\'"]([A-Za-z0-9_:]+)[\'"]')
+    # JitWatch program names are an interface too: the cost model keys
+    # its inventory (and `report costs` its efficiency join) on them, so
+    # a watched program whose name is missing from the registry silently
+    # escapes cost accounting.  The pattern tolerates a positional fn
+    # arg with one nested call level (JitWatch(self._build_program(...),
+    # name=f"...")) and stops capture at "(" so the f-string chunk names
+    # contribute their stable prefix (ptrainer.chunk, ...).
+    JITWATCH_PAT = re.compile(
+        r'JitWatch\((?:[^()\'"]|\([^()]*\))*?'
+        r'(?:name\s*=\s*)?f?[\'"]([A-Za-z0-9_.]+)')
 
     def _source_names(self):
         import pathlib
@@ -694,13 +704,20 @@ class TestNameRegistryLint:
         names = {}
         files = list((repo / "lightgbm_tpu").rglob("*.py"))
         files.append(repo / "bench.py")
+        jitwatch_names = 0
         for p in files:
             src = p.read_text()
             for name in self.TRACER_PAT.findall(src):
                 names.setdefault(name, str(p))
             for name in self.METRIC_PAT.findall(src):
                 names.setdefault(name, str(p))
+            for name in self.JITWATCH_PAT.findall(src):
+                names.setdefault(name, str(p))
+                jitwatch_names += 1
         assert len(names) > 40, "lint scan found suspiciously few names"
+        assert jitwatch_names >= 10, (
+            "lint scan found suspiciously few JitWatch constructions — "
+            "did the JITWATCH_PAT regex rot?")
         return names, repo
 
     def test_every_emitted_name_is_documented(self):
@@ -719,3 +736,25 @@ class TestNameRegistryLint:
         names = {"documented.name": "a.py", "brand.new.span": "b.py"}
         missing = {n for n in names if f"`{n}`" not in doc}
         assert missing == {"brand.new.span"}
+
+    def test_jitwatch_pattern_catches_real_construction_shapes(self):
+        """JITWATCH_PAT must survive every construction idiom the repo
+        uses: positional name, name= kwarg, a nested-call fn argument,
+        and the f-string chunk names (capturing their stable prefix)."""
+        src = '\n'.join([
+            'w = JitWatch(predict_raw, "serve.predict_raw",',
+            '             phase="serve_batch")',
+            'x = JitWatch(upd, name="ptrainer.traced.update",',
+            '             phase="histogram")',
+            'self._progs[k] = JitWatch(',
+            '    self._build_program(alloc, bag_on, bag_freq, ff),',
+            '    name=f"ptrainer.chunk(bag={int(bag_on)},ff={ff})",',
+            ')',
+        ])
+        got = set(self.JITWATCH_PAT.findall(src))
+        assert got == {"serve.predict_raw", "ptrainer.traced.update",
+                       "ptrainer.chunk"}
+        # and an undocumented watched program is reported missing
+        doc = "| `serve.predict_raw` | program | x | y |"
+        missing = {n for n in got if f"`{n}`" not in doc}
+        assert missing == {"ptrainer.traced.update", "ptrainer.chunk"}
