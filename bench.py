@@ -114,6 +114,20 @@ def apply_regression_gate(out: dict, bench_dir: str = None, env=None) -> int:
         if float(rr) < 1.3:
             out["regression_elastic_recovery"] = True
             rc = 1
+    # distributed out-of-core quantized-parity leg, same regime: int32
+    # per-chunk fold partials are associative, so the model bytes must
+    # match EXACTLY across chunk grids — protocol arithmetic, gated
+    # outright even on device_tunnel_dead captures (docs/DATA.md)
+    od = out.get("ooc_distributed") or {}
+    if od and not od.get("error") and "quantized_parity_ok" in od:
+        out["gate_oocdist"] = {
+            "require_quantized_parity": True,
+            "quantized_parity_ok": bool(od["quantized_parity_ok"]),
+            "chunk_grids": od.get("chunk_grids"),
+        }
+        if not od["quantized_parity_ok"]:
+            out["regression_oocdist_parity"] = True
+            rc = 1
     if out.get("backend_fallback"):
         return rc
     best, src = best_prior_sec_per_iter(bench_dir, out.get("metric"))
@@ -1383,6 +1397,94 @@ def _bench_elastic():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _bench_ooc_distributed():
+    """Distributed out-of-core section (docs/DATA.md "Distributed
+    streaming", docs/PARALLEL.md): two REAL 2-rank subprocess fleets
+    (tests/oocdist_worker.py — every rank streams its own shard through
+    the prefetch ring, node histograms allreduced on the ``hist_q``
+    wire) trained under quantized_training at two DIFFERENT per-rank
+    chunk grids, then a byte-compare of the final models.
+
+    ``quantized_parity_ok`` is the integer-fold associativity contract:
+    per-chunk int32 partials cannot depend on the chunk grid, so the
+    model bytes must match EXACTLY — protocol arithmetic, not a timing,
+    which is why the gate holds it outright even on backend_fallback /
+    device_tunnel_dead captures (apply_regression_gate).
+    BENCH_OOCDIST=0 skips; BENCH_OOCDIST_ROWS / BENCH_OOCDIST_TREES
+    resize."""
+    import socket
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tests", "oocdist_worker.py")
+    rows = int(os.environ.get("BENCH_OOCDIST_ROWS", 16384))
+    trees = int(os.environ.get("BENCH_OOCDIST_TREES", 3))
+    grids = (2048, 9999)  # round to 4096 (2 chunks/rank) vs 12288 (1)
+    try:
+        if not os.path.exists(worker):
+            return {"error": f"FileNotFoundError: {worker}"}
+
+        def fleet(tag, grid, tmp):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            base = {k: v for k, v in os.environ.items()
+                    if k not in ("XLA_FLAGS", "JAX_PLATFORMS",
+                                 "LIGHTGBM_TPU_FAULT",
+                                 "LIGHTGBM_TPU_FAULT_RANK",
+                                 "LIGHTGBM_TPU_TRACE",
+                                 "LIGHTGBM_TPU_OOC",
+                                 "LIGHTGBM_TPU_DEVICE_BUDGET")}
+            repo = os.path.dirname(os.path.abspath(__file__))
+            base["PYTHONPATH"] = repo + os.pathsep + base.get(
+                "PYTHONPATH", "")
+            base.update(OOCDIST_ROWS=str(rows), OOCDIST_TREES=str(trees),
+                        OOCDIST_OOC="true", OOCDIST_QUANT="1",
+                        OOCDIST_LEAVES="15",
+                        OOCDIST_CHUNK_ROWS=str(grid))
+            outp = os.path.join(tmp, tag)
+            t0 = time.time()
+            procs = [subprocess.Popen(
+                [_sys.executable, worker, str(r), "2", str(port), outp,
+                 "train", "-"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=dict(base)) for r in range(2)]
+            logs = [p.communicate(timeout=600)[0] for p in procs]
+            if any(p.returncode != 0 for p in procs):
+                raise RuntimeError(
+                    "oocdist fleet failed: " + logs[0][-500:])
+            wall = time.time() - t0
+            models, stats = [], []
+            for r in range(2):
+                with open(outp + f".rank{r}.txt") as fh:
+                    models.append(fh.read())
+                with open(outp + f".rank{r}.json") as fh:
+                    stats.append(json.load(fh))
+            return models, stats, wall
+
+        with tempfile.TemporaryDirectory(prefix="bench_oocdist_") as tmp:
+            runs = {g: fleet(f"g{g}", g, tmp) for g in grids}
+        ref = runs[grids[0]][0][0]
+        parity = all(m == ref for models, _, _ in runs.values()
+                     for m in models)
+        g0 = runs[grids[0]][1][0]
+        return {
+            "rows": rows, "trees": trees, "ranks": 2,
+            "chunk_grids": list(grids),
+            "chunks_per_pass": {
+                g: runs[g][1][0]["chunks_per_pass"] for g in grids},
+            "fleet_wall_s": {
+                g: round(runs[g][2], 2) for g in grids},
+            "stream_stats_rank0": g0["stream_stats"],
+            "quantized_parity_ok": parity,
+        }
+    except Exception as e:  # pragma: no cover
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _auc(y, s):
     """AUC via the library's own metric (one implementation to trust)."""
     from lightgbm_tpu.config import Config
@@ -1817,6 +1919,13 @@ def main():
     # device-independent leg of the regression gate.
     if os.environ.get("BENCH_ELASTIC", "1") != "0":
         out["elastic"] = _bench_elastic()
+
+    # distributed out-of-core section (docs/DATA.md): 2-rank streaming
+    # fleets at two chunk grids + the quantized byte-parity contract.
+    # Runs even on backend_fallback: integer-fold associativity is
+    # protocol arithmetic, the device-independent leg of the gate.
+    if os.environ.get("BENCH_OOCDIST", "1") != "0":
+        out["ooc_distributed"] = _bench_ooc_distributed()
 
     # kernel A/B section (docs/PERFORMANCE.md): the PR-6 kernel wins
     # measured head-to-head WITH parity checks — on a dead tunnel this is

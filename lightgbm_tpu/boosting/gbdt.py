@@ -126,9 +126,10 @@ class GBDT:
         if ooc_on:
             forced = "forced" in ooc_why
             unsupported = None
-            if config.tree_learner.lower() != "serial":
+            if config.tree_learner.lower() not in ("serial", "data"):
                 unsupported = (
-                    f"tree_learner={config.tree_learner} (serial only)")
+                    f"tree_learner={config.tree_learner} (streaming "
+                    "supports serial, or data with per-rank shards)")
             elif not self.supports_ooc:
                 unsupported = f"boosting type {type(self).__name__}"
             if unsupported is not None:
@@ -152,12 +153,16 @@ class GBDT:
                 import jax as _jax
 
                 if _jax.process_count() > 1:
-                    # the data-parallel psum sums GLOBAL rows into a bin
-                    from jax.experimental import multihost_utils
+                    # the data-parallel merge sums GLOBAL rows into a
+                    # bin; gather the per-rank counts over the byte
+                    # collectives (works on the KV transport too, where
+                    # XLA:CPU has no multi-process computations)
+                    from ..parallel import collect as _collect
 
-                    n_rows = int(np.asarray(
-                        multihost_utils.process_allgather(
-                            np.asarray([float(self.num_data)]))).sum())
+                    blobs = _collect.allgather_bytes(
+                        int(self.num_data).to_bytes(8, "little"), "misc")
+                    n_rows = sum(int.from_bytes(b, "little")
+                                 for b in blobs)
             limit = _qhist.max_rows_for(config.quantized_grad_bits)
             if n_rows > limit:
                 Log.warning(
@@ -189,10 +194,31 @@ class GBDT:
         self.learner = None
         self.ptrainer = None
         if ooc_on:
-            from .ooc import OocTrainer
+            import jax as _jax
 
-            self.ooc = OocTrainer(
-                train_set, config, self.grow_params, ooc_chunk_rows)
+            if learner_type == "data" and _jax.process_count() > 1:
+                # rank-sharded streaming: every rank streams its own
+                # shard and node histograms merge over the hardened
+                # byte collectives (boosting/oocdist.py)
+                from ..parallel.comm import NetComm
+                from .oocdist import DistributedOocTrainer
+
+                self.ooc = DistributedOocTrainer(
+                    train_set, config, self.grow_params, ooc_chunk_rows,
+                    NetComm())
+                Log.info(
+                    "Using distributed out-of-core data-parallel "
+                    "learner over %d processes", _jax.process_count())
+            else:
+                if learner_type == "data":
+                    Log.warning(
+                        "tree_learner=data requested with out-of-core "
+                        "streaming but only one process is attached; "
+                        "streaming serially")
+                from .ooc import OocTrainer
+
+                self.ooc = OocTrainer(
+                    train_set, config, self.grow_params, ooc_chunk_rows)
             self.learner = self.ooc
         elif learner_type in ("data", "feature", "voting"):
             import jax as _jax
@@ -1065,6 +1091,13 @@ class GBDT:
         self.best_msg = [list(map(str, b)) for b in py["best_msg"]]
         self.class_need_train = list(py["class_need_train"])
         self.class_default_output = list(py["class_default_output"])
+        if self.learner is not None and hasattr(self.learner, "_qiter"):
+            # internally-quantizing learners draw per-tree stochastic-
+            # rounding seeds from a tree counter; re-anchor it to the
+            # restored model list so a resumed run rounds exactly like
+            # one that never died (counter increments before use, one
+            # grow per appended model including empty alignment trees)
+            self.learner._qiter = len(self.models) - 1
         if self.ptrainer is not None:
             if "pt_rowid" in arrays:
                 self.ptrainer.import_perm(np.asarray(arrays["pt_rowid"]))

@@ -23,6 +23,11 @@ children's histogram partials (ops/ooc.py ``split_chunk`` — 2x flops for
 accumulated histogram of the *smaller* child is kept and the larger is
 derived by the subtraction trick, exactly as in-memory.
 
+The streaming machinery itself — source selection, the prefetch ring,
+and the per-chunk fold loops — lives in ``data/chunksource.py``
+(:class:`ChunkStream` / :class:`ChunkFolder`), the seam this trainer
+shares with the rank-sharded :class:`~..boosting.oocdist.DistributedOocTrainer`.
+
 Bit-identity contract: with ``chunk_rows`` a ``ROW_BLOCK`` multiple
 (enforced by rounding up), the streamed histogram folds reproduce the
 in-memory scan's left-to-right block adds bit-for-bit, and every other
@@ -42,26 +47,17 @@ from typing import Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from ..data.prefetch import (
-    ArrayChunkSource,
-    CacheChunkSource,
+from ..data.chunksource import (
+    ChunkFolder,
     ChunkPlan,
-    ChunkPrefetcher,
+    ChunkStream,
     PrefetchStats,
+    make_chunk_source,
 )
 from ..obs import tracer
 from ..ops.grow import GrowResult
 from ..ops.histogram import ROW_BLOCK
-from ..ops.ooc import (
-    child_leaf_values,
-    find_best_split,
-    root_hist_chunk,
-    root_totals,
-    scatter_add_slice,
-    split_chunk,
-    subtract_sibling,
-)
-from ..ops.predict import predict_binned
+from ..ops.ooc import child_leaf_values, find_best_split, root_totals
 from ..ops.qhist import dequantize_hist, dequantize_sums
 from ..ops.split import NEG_INF
 from ..utils.log import Log
@@ -97,7 +93,9 @@ def resolve_chunk_rows(config, num_features: int, itemsize: int) -> int:
     """The streaming chunk height: ``ooc_chunk_rows`` when set, else
     ~64 MiB of packed rows — always rounded UP to a ``ROW_BLOCK``
     multiple, the bit-identity alignment contract (a 1-row request
-    degenerates to one block, never to a shorter summation)."""
+    degenerates to one block, never to a shorter summation).  Under the
+    distributed trainer the same rounding applies per rank, over that
+    rank's shard rows."""
     rows = int(getattr(config, "ooc_chunk_rows", 0) or 0)
     if rows <= 0:
         row_bytes = max(num_features * itemsize, 1)
@@ -110,7 +108,9 @@ def resolve_out_of_core(config, train_set) -> Tuple[bool, int, str]:
 
     ``out_of_core`` = true/false forces; "auto" turns streaming on only
     when the packed matrix exceeds the device budget.  The
-    LIGHTGBM_TPU_OOC env var overrides the config knob per-run."""
+    LIGHTGBM_TPU_OOC env var overrides the config knob per-run.  In a
+    multi-process run ``train_set`` is this rank's shard, so the budget
+    comparison (and the chunk grid) is naturally per rank."""
     mode = os.environ.get("LIGHTGBM_TPU_OOC", "").strip().lower()
     if not mode:
         mode = str(getattr(config, "out_of_core", "auto")).strip().lower()
@@ -152,7 +152,12 @@ class OocTrainer:
         self.plan = ChunkPlan(self.num_rows, chunk_rows)
         self.stats = PrefetchStats()
         self.depth = max(int(getattr(config, "ooc_prefetch_depth", 2) or 2), 1)
-        self.source = self._make_source(train_set)
+        self.source = make_chunk_source(train_set)
+        self.chunks = ChunkStream(self.source, self.plan, self.depth,
+                                  self.stats)
+        self.folder = ChunkFolder(self.chunks, self.num_features,
+                                  self.params.num_bins,
+                                  self.params.row_block)
         self._trees_grown = 0
         tracer.event(
             "ooc.plan",
@@ -166,28 +171,10 @@ class OocTrainer:
             self.plan.chunk_rows, self.source.describe(), self.depth,
         )
 
-    @staticmethod
-    def _make_source(train_set):
-        """Prefer checksummed reads straight from the v2 binary cache the
-        dataset was loaded from; any other dataset streams from its host
-        (or memmapped) ``binned`` array."""
-        path = getattr(train_set, "cache_path", None)
-        if path:
-            from ..data.cache import open_cache_reader
-
-            reader = open_cache_reader(path)
-            if reader is not None:
-                return CacheChunkSource(reader)
-        return ArrayChunkSource(np.asarray(train_set.binned))
-
     def schedule_fingerprint(self) -> str:
         """Chunk-schedule identity for checkpoints: a resume streaming a
         different grid would change float summation order."""
         return self.plan.fingerprint()
-
-    def _stream(self):
-        return ChunkPrefetcher(self.source, self.plan, self.depth,
-                               self.stats).stream()
 
     # ------------------------------------------------------------------
     def grow(self, bins_ignored, grad, hess, select, feature_mask,
@@ -197,7 +184,8 @@ class OocTrainer:
         Host-driven replay of ``grow_tree``'s best-first loop: the
         per-leaf tables live on host as np.float32 (f32 round-trips are
         exact; ``np.argmax`` keeps the same first-max tie-break), the
-        histograms live on device and accumulate chunk-by-chunk.
+        histograms live on device and accumulate chunk-by-chunk through
+        the ChunkFolder's streamed folds.
 
         Quantized training: int16 ``grad``/``hess`` (plus the (2,)
         ``qscale``) switch the streamed folds to exact int32 — integer
@@ -206,8 +194,6 @@ class OocTrainer:
         boundaries for that) — and dequantization happens once per
         node, just before the split scan."""
         L = self.params.num_leaves
-        B = self.params.num_bins
-        rb = self.params.row_block
         use_missing = self.params.use_missing
         stats0 = dict(self.stats.as_dict())
         quant = jnp.issubdtype(grad.dtype, jnp.integer)
@@ -222,11 +208,7 @@ class OocTrainer:
             sums_dev = root_totals(grad, hess, select)
             if quant:
                 sums_dev = dequantize_sums(sums_dev, qscale)
-            hist = jnp.zeros((self.num_features, B, 3),
-                             jnp.int32 if quant else jnp.float32)
-            for _i, start, _stop, chunk in self._stream():
-                hist = root_hist_chunk(hist, chunk, grad, hess, select,
-                                       np.int32(start), B, rb)
+            hist = self.folder.fold_root(grad, hess, select)
             root_sums = np.asarray(sums_dev, np.float32)
             root_res = find_best_split(deq(hist), sums_dev, feature_mask,
                                        True, meta, hyper, use_missing)
@@ -287,27 +269,17 @@ class OocTrainer:
                 rval = np.float32(rval_d)
 
                 # ---- one streamed pass: partition + both children hists
-                hist_l = jnp.zeros_like(pool[bl])
-                hist_r = jnp.zeros_like(pool[bl])
-                n_left = jnp.zeros((), jnp.int32)
-                for _i, start, _stop, chunk in self._stream():
-                    leaf_id, hist_l, hist_r, n_left = split_chunk(
-                        leaf_id, hist_l, hist_r, n_left, chunk, grad,
-                        hess, select, np.int32(start), np.int32(feat),
-                        np.int32(default_bin[feat]), np.int32(dbz),
-                        np.int32(thr), bool(is_categorical[feat]),
-                        np.int32(bl), np.int32(rl), B, rb,
-                    )
+                leaf_id, hist_l, hist_r, n_left = self.folder.fold_split(
+                    leaf_id, pool[bl], grad, hess, select, feat,
+                    int(default_bin[feat]), dbz, thr,
+                    bool(is_categorical[feat]), bl, rl,
+                )
                 n_rows_left = int(n_left)
                 n_rows_right = int(leaf_rows[bl]) - n_rows_left
                 # smaller child keeps its DIRECT accumulation; the larger
                 # is parent - smaller, matching the in-memory numerics
-                if n_rows_left < n_rows_right:
-                    left_hist = hist_l
-                    right_hist = subtract_sibling(pool[bl], hist_l)
-                else:
-                    right_hist = hist_r
-                    left_hist = subtract_sibling(pool[bl], hist_r)
+                left_hist, right_hist = ChunkFolder.pick_children(
+                    pool[bl], hist_l, hist_r, n_rows_left, n_rows_right)
                 pool[bl] = left_hist
                 pool[rl] = right_hist
 
@@ -361,31 +333,18 @@ class OocTrainer:
     # ------------------------------------------------------------------
     def add_tree_scores(self, score_k, arrays):
         """Streamed ``predict_binned`` over the chunk grid: the rollback /
-        DART score path when the matrix is not device-resident.  The
-        traversal is per-row, so chunking is exact."""
-        for _i, start, _stop, chunk in self._stream():
-            delta = predict_binned(
-                chunk,
-                arrays["split_feature_inner"],
-                arrays["threshold_bin"],
-                arrays["zero_bin"],
-                arrays["default_bin_for_zero"],
-                arrays["is_categorical"],
-                arrays["left_child"],
-                arrays["right_child"],
-                arrays["leaf_value"],
-            )
-            score_k = scatter_add_slice(score_k, delta, np.int32(start))
-        return score_k
+        DART score path when the matrix is not device-resident."""
+        return self.folder.streamed_scores(score_k, arrays)
 
-    def _emit_stream_obs(self, before: dict) -> None:
+    def _emit_stream_obs(self, before: dict, **attrs) -> None:
         if not tracer.enabled:
             return
         now = self.stats.as_dict()
-        tracer.counter("ooc.chunks", now["chunks"] - before["chunks"])
-        tracer.counter("ooc.bytes", now["bytes"] - before["bytes"])
+        tracer.counter("ooc.chunks", now["chunks"] - before["chunks"],
+                       **attrs)
+        tracer.counter("ooc.bytes", now["bytes"] - before["bytes"], **attrs)
         tracer.gauge("ooc.fetch_ms",
-                     (now["fetch_s"] - before["fetch_s"]) * 1e3)
+                     (now["fetch_s"] - before["fetch_s"]) * 1e3, **attrs)
         tracer.gauge("ooc.stall_ms",
-                     (now["stall_s"] - before["stall_s"]) * 1e3)
-        tracer.gauge("ooc.overlap_pct", now["overlap_pct"])
+                     (now["stall_s"] - before["stall_s"]) * 1e3, **attrs)
+        tracer.gauge("ooc.overlap_pct", now["overlap_pct"], **attrs)

@@ -1,0 +1,410 @@
+"""Rank-sharded out-of-core training: every rank streams its own shard.
+
+The two scale axes built so far — disk (OocTrainer streams the bin
+matrix, PR 8) and fleet (the host-driven data-parallel learner
+allreduces histograms, PR 5/13) — compose here: each rank streams its
+OWN contiguous row shard from its own chunk source through the bounded
+prefetch ring, folds per-chunk histogram partials locally via the
+shared ChunkFolder seam (data/chunksource.py), and exchanges only the
+per-NODE histograms over the hardened KV transport.  Peak device
+residency per rank stays O(2 chunks); wire volume stays O(F·B) per node
+— the same observation "Out-of-Core GPU Gradient Boosting" and
+XGBoost's external-memory mode make: chunked external-memory folds and
+data-parallel allreduce are independent axes.
+
+Exchange pattern per tree (HostParallelLearner's data mode, verbatim):
+
+  - quantized: allgather (max|g|, max|h|) -> one global scale; exact
+    int64 root totals; every node histogram ships as the 2-plane int16
+    ``hist_q`` wire (F*B*4 bytes vs f32x3's F*B*12) and merges in exact
+    integer arithmetic;
+  - f32: root totals and histograms merge with rank-order sequential
+    IEEE adds (the determinism anchor);
+  - smaller-child selection uses GLOBAL row counts (a 8-byte ``_CNT``
+    allgather), so every rank subtracts the same sibling.
+
+Determinism contract (pinned by tests/test_oocdist.py): with
+``quantized_training`` on, per-chunk int32 partials are associative, so
+the merged node histogram — and therefore the model — is BYTE-IDENTICAL
+for any per-rank chunk grid AND any rank count.  The f32 path keeps
+per-rank folds bit-identical to that rank's in-memory scan (ROW_BLOCK
+alignment) and is deterministic for a fixed world size, but its
+rank-order merge makes the result world-dependent, exactly like the
+in-memory data-parallel learner.
+
+The host replays identical decisions on every rank from identical
+gathered bytes, so collectives stay in lockstep program order (the KV
+GC invariant).  Checkpoints ride the canonical topology-portable layout
+(ckpt/state.py): the per-rank chunk grid is recorded as a ``dist/``
+schedule fingerprint, which ``restore()`` exempts from the serial
+grid-equality refusal — per-rank grids legitimately differ across world
+sizes, while the GLOBAL dataset fingerprint stays enforced by the
+canonical container handshake.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.chunksource import (
+    ChunkFolder,
+    ChunkPlan,
+    ChunkStream,
+    PrefetchStats,
+    make_chunk_source,
+)
+from ..obs import tracer
+from ..ops import qhist
+from ..ops.grow import GrowResult
+from ..ops.ooc import child_leaf_values, find_best_split, root_totals
+from ..ops.split import NEG_INF
+from ..parallel.comm import Comm
+from ..utils.log import Log
+from .ooc import OocTrainer
+
+# wire formats shared with parallel/hostlearner.py: 8-byte local
+# (n_left, n_right) row counts, 12-byte f32 root sums, 8-byte quantized
+# scale maxima, 24-byte exact int64 quantized root totals
+_CNT = struct.Struct("<ii")
+_SUMS = struct.Struct("<fff")
+_QMAX = struct.Struct("<ff")
+_QSUMS = struct.Struct("<qqq")
+
+
+class DistributedOocTrainer:
+    """Drop-in ``learner`` for GBDT over a :class:`Comm`: ``grow()``
+    matches OocTrainer's surface; inputs are this rank's row shard
+    (vectors device-resident, matrix streamed from this rank's chunk
+    source)."""
+
+    # gbdt.py hands us f32 gradients even under quantized_training: the
+    # quantization scale must be a max over ALL ranks' rows, so the
+    # allgather of local maxima happens inside grow, over the KV
+    # transport (XLA:CPU has no multi-process computations)
+    quantizes_internally = True
+
+    def __init__(self, train_set, config, grow_params, chunk_rows: int,
+                 comm: Comm):
+        self.params = grow_params._replace(compact=False)
+        self.comm = comm
+        self.num_rows = int(train_set.num_data)  # LOCAL shard rows
+        self.num_features = int(train_set.num_features)
+        self.plan = ChunkPlan(self.num_rows, chunk_rows)
+        self.stats = PrefetchStats()
+        self.depth = max(int(getattr(config, "ooc_prefetch_depth", 2) or 2), 1)
+        self.source = make_chunk_source(train_set)
+        self.chunks = ChunkStream(self.source, self.plan, self.depth,
+                                  self.stats)
+        self.folder = ChunkFolder(self.chunks, self.num_features,
+                                  self.params.num_bins,
+                                  self.params.row_block)
+        self.quant = bool(self.params.quantized)
+        self._qiter = -1  # stochastic-rounding counter (ckpt-synced)
+        self._qscales = None  # (2,) np.float32 scales of the current tree
+        self._trees_grown = 0
+        tracer.event(
+            "ooc.plan",
+            rows=self.num_rows, features=self.num_features,
+            chunk_rows=self.plan.chunk_rows, chunks=self.plan.num_chunks,
+            depth=self.depth, source=self.source.describe(),
+            rank=self.comm.rank, world=self.comm.nproc,
+        )
+        Log.info(
+            "Distributed out-of-core training: rank %d/%d streams %d rows "
+            "in %d chunks of %d (%s, prefetch depth %d, %s histogram wire)",
+            self.comm.rank, self.comm.nproc, self.num_rows,
+            self.plan.num_chunks, self.plan.chunk_rows,
+            self.source.describe(), self.depth,
+            "hist_q int16/int32" if self.quant else "f32",
+        )
+
+    def schedule_fingerprint(self) -> str:
+        """Per-rank chunk-schedule identity.  The ``dist/`` prefix tells
+        ``ckpt/state.py`` this grid is rank-local: integer folds are
+        associative (and f32 folds ROW_BLOCK-aligned), so an elastic
+        resume at a different world size — hence a different per-rank
+        grid — is sound, and only the global dataset fingerprint gates
+        the resume."""
+        return (f"dist/{self.comm.nproc}w/r{self.comm.rank}/"
+                f"{self.plan.fingerprint()}")
+
+    def set_plan(self, plan) -> None:
+        """Shard-plan seam parity with the other parallel learners: row
+        moves are declined for out-of-core shards (rows are
+        disk-resident; gbdt.py's rebalance arming already excludes us),
+        so this is never reached with a changed plan."""
+        del plan
+
+    # -- merge helpers (hostlearner.py wire semantics) -----------------
+
+    @staticmethod
+    def _merge_f32(blobs: List[bytes], shape) -> np.ndarray:
+        """Rank-order sequential IEEE f32 adds — deterministic for a
+        fixed world size."""
+        parts = [np.frombuffer(b, np.float32).reshape(shape) for b in blobs]
+        tot = parts[0].copy()
+        for p in parts[1:]:
+            tot = tot + p
+        return tot
+
+    @staticmethod
+    def _merge_q(blobs: List[bytes], f: int, b: int):
+        """Exact integer merge of ``hist_q`` payloads; returns
+        ``(planes, counts)`` with ``counts`` the summed exact count
+        plane of any 3-plane (degenerate-node) payloads."""
+        tot = np.zeros((f, b, 2), np.int64)
+        counts = None
+        for blob in blobs:
+            arr = qhist.unpack_hist_q(blob, f, b)
+            tot = tot + arr[..., :2]
+            if arr.shape[-1] == 3:
+                c = arr[..., 2].astype(np.int64)
+                counts = c if counts is None else counts + c
+        return tot, counts
+
+    @staticmethod
+    def _q_counts_if_degenerate(hist3: np.ndarray):
+        """Ship the exact count plane iff this rank's quantized hessian
+        mass for the node is zero while it still holds rows (hessians
+        are non-negative, so the GLOBAL mass is zero iff every rank's
+        is)."""
+        if (int(hist3[0, :, 1].sum()) == 0
+                and int(hist3[0, :, 2].sum()) > 0):
+            return hist3[..., 2]
+        return None
+
+    def _global_hist(self, local_hist, node_cnt: float) -> np.ndarray:
+        """Allgather + merge one node's local histogram partial into the
+        global (F, B, 3) f32 histogram every rank scans identically."""
+        f, b = self.num_features, self.params.num_bins
+        if self.quant:
+            h3 = np.asarray(local_hist)
+            blob = qhist.pack_hist_q(
+                h3[..., :2], self._q_counts_if_degenerate(h3))
+            blobs = self.comm.allgather(blob, "hist_q")
+            merged, exact_cnt = self._merge_q(blobs, f, b)
+            return qhist.assemble_hist(merged, self._qscales,
+                                       float(node_cnt), counts=exact_cnt)
+        blobs = self.comm.allgather(
+            np.asarray(local_hist, np.float32).tobytes(), "hist")
+        return self._merge_f32(blobs, (f, b, 3))
+
+    def _find_best(self, local_hist, sums: np.ndarray, depth_ok: bool,
+                   feature_mask, meta, hyper):
+        """(gain, feat, thr, dbz, left(3,)) from the MERGED histogram —
+        identical on every rank, so the replayed loops stay lockstep."""
+        ghist = self._global_hist(local_hist, float(sums[2]))
+        res = find_best_split(jnp.asarray(ghist),
+                              jnp.asarray(np.asarray(sums, np.float32)),
+                              feature_mask, bool(depth_ok), meta, hyper,
+                              self.params.use_missing)
+        left = np.asarray(
+            [res.left_sum_g, res.left_sum_h, res.left_cnt], np.float32)
+        return (np.float32(res.gain), int(res.feature),
+                int(res.threshold_bin), int(res.default_bin_for_zero),
+                left)
+
+    # -- root totals ---------------------------------------------------
+
+    def _root_sums_global(self, sums_local) -> np.ndarray:
+        """Merge per-rank root totals: exact Python-int sums of the
+        int32 quantized totals (then one host-side dequantization), or
+        rank-order f32 adds."""
+        if self.quant:
+            s = np.asarray(sums_local)
+            blobs = self.comm.allgather(
+                _QSUMS.pack(int(s[0]), int(s[1]), int(s[2])), "hist_q")
+            sums_i = [_QSUMS.unpack(b) for b in blobs]
+            tot_g = sum(v[0] for v in sums_i)
+            tot_h = sum(v[1] for v in sums_i)
+            tot_c = sum(v[2] for v in sums_i)
+            return np.asarray(
+                [np.float32(np.float32(tot_g) * self._qscales[0]),
+                 np.float32(np.float32(tot_h) * self._qscales[1]),
+                 np.float32(tot_c)], np.float32)
+        s = np.asarray(sums_local, np.float32)
+        blobs = self.comm.allgather(
+            _SUMS.pack(float(s[0]), float(s[1]), float(s[2])), "best_split")
+        vals = [np.array(_SUMS.unpack(b), np.float32) for b in blobs]
+        tot = vals[0].copy()
+        for v in vals[1:]:
+            tot = tot + v
+        return tot
+
+    # ------------------------------------------------------------------
+    def grow(self, bins_ignored, grad, hess, select, feature_mask,
+             meta, hyper, qscale=None) -> GrowResult:
+        """Grow one leaf-wise tree: every rank streams its shard, folds
+        local per-node partials, and merges them per node.
+
+        The host-side replay mirrors OocTrainer.grow; the only
+        distributed additions are the four exchange points (scale
+        maxima, root totals, per-node histograms, child row counts)."""
+        del qscale  # quantizes internally; driver never passes one
+        L = self.params.num_leaves
+        stats0 = dict(self.stats.as_dict())
+
+        if self.quant:
+            # per-tree quantization: global scales from allgathered local
+            # maxima, then value-keyed stochastic rounding — a row
+            # quantizes the same way whichever rank holds it, so the
+            # merged integer histogram is invariant under rank count and
+            # chunk grid.  _qiter is ckpt-synced (import_train_state), so
+            # a resumed run draws the same rounding as one that never
+            # died.
+            self._qiter += 1
+            seed = (int(self.params.quant_seed) * 2654435761
+                    + self._qiter * 97 + 1) & 0xFFFFFFFF
+            mx = np.asarray(qhist.local_absmax(grad, hess, select),
+                            np.float32)
+            blobs = self.comm.allgather(
+                _QMAX.pack(float(mx[0]), float(mx[1])), "hist_q")
+            maxima = [_QMAX.unpack(b) for b in blobs]
+            self._qscales = qhist.scales_from_max(
+                max(m[0] for m in maxima), max(m[1] for m in maxima),
+                self.params.quant_bits)
+            grad, hess = qhist.quantize_rows(
+                grad, hess, jnp.asarray(self._qscales), np.uint32(seed),
+                self.params.quant_bits)
+
+        with tracer.span("ooc.grow", tree=self._trees_grown,
+                         chunks=self.plan.num_chunks, rank=self.comm.rank):
+            # ---- root: local streamed fold + global merges
+            root_sums = self._root_sums_global(root_totals(grad, hess,
+                                                           select))
+            hist = self.folder.fold_root(grad, hess, select)
+
+            bs_gain = np.full((L,), NEG_INF, np.float32)
+            bs_feat = np.zeros((L,), np.int32)
+            bs_thr = np.zeros((L,), np.int32)
+            bs_dbz = np.zeros((L,), np.int32)
+            bs_left = np.zeros((L, 3), np.float32)
+            leaf_sum = np.zeros((L, 3), np.float32)
+            leaf_value = np.zeros((L,), np.float32)
+            leaf_cnt = np.zeros((L,), np.float32)
+            leaf_depth = np.zeros((L,), np.int32)
+            leaf_rows = np.zeros((L,), np.int64)  # LOCAL rows
+            rec_i = {k: np.zeros((L - 1,), np.int32)
+                     for k in ("leaf", "feat", "thr", "dbz")}
+            rec_f = {k: np.zeros((L - 1,), np.float32)
+                     for k in ("gain", "lval", "rval", "lcnt", "rcnt",
+                               "internal_value")}
+            leaf_sum[0] = root_sums
+            leaf_cnt[0] = root_sums[2]
+            leaf_rows[0] = self.num_rows
+
+            def store(leaf: int, res) -> None:
+                bs_gain[leaf] = res[0]
+                bs_feat[leaf] = np.int32(res[1])
+                bs_thr[leaf] = np.int32(res[2])
+                bs_dbz[leaf] = np.int32(res[3])
+                bs_left[leaf] = res[4]
+
+            store(0, self._find_best(hist, root_sums, True, feature_mask,
+                                     meta, hyper))
+            pool = {0: hist}
+            leaf_id = jnp.zeros((self.num_rows,), jnp.int32)
+            default_bin = np.asarray(meta.default_bin)
+            is_categorical = np.asarray(meta.is_categorical)
+
+            num_splits = 0
+            while num_splits < L - 1:
+                bl = int(np.argmax(bs_gain))
+                gain = bs_gain[bl]
+                if not (gain > 0.0):
+                    break  # no further splits with positive gain
+                s = num_splits
+                rl = s + 1
+                feat = int(bs_feat[bl])
+                thr = int(bs_thr[bl])
+                dbz = int(bs_dbz[bl])
+                left = bs_left[bl].copy()
+                right = leaf_sum[bl] - left
+                lval_d, rval_d = child_leaf_values(
+                    left, right, hyper.lambda_l1, hyper.lambda_l2)
+                lval = np.float32(lval_d)
+                rval = np.float32(rval_d)
+
+                # ---- one streamed pass: partition + both children hists
+                leaf_id, hist_l, hist_r, n_left = self.folder.fold_split(
+                    leaf_id, pool[bl], grad, hess, select, feat,
+                    int(default_bin[feat]), dbz, thr,
+                    bool(is_categorical[feat]), bl, rl,
+                )
+                n_left = int(n_left)
+                n_right = int(leaf_rows[bl]) - n_left
+                # smaller child by GLOBAL row count: every rank must keep
+                # the direct accumulation for the same child or the
+                # subtraction trick would mix siblings across the merge
+                blobs = self.comm.allgather(_CNT.pack(n_left, n_right),
+                                            "best_split")
+                cnts = [_CNT.unpack(b) for b in blobs]
+                g_left = sum(c[0] for c in cnts)
+                g_right = sum(c[1] for c in cnts)
+                left_hist, right_hist = ChunkFolder.pick_children(
+                    pool[bl], hist_l, hist_r, g_left, g_right)
+                pool[bl] = left_hist
+                pool[rl] = right_hist
+
+                child_depth = int(leaf_depth[bl]) + 1
+                depth_ok = (self.params.max_depth <= 0
+                            or child_depth < self.params.max_depth)
+                lres = self._find_best(left_hist, left, depth_ok,
+                                       feature_mask, meta, hyper)
+                rres = self._find_best(right_hist, right, depth_ok,
+                                       feature_mask, meta, hyper)
+
+                rec_i["leaf"][s] = bl
+                rec_i["feat"][s] = feat
+                rec_i["thr"][s] = thr
+                rec_i["dbz"][s] = dbz
+                rec_f["gain"][s] = gain
+                rec_f["lval"][s] = lval
+                rec_f["rval"][s] = rval
+                rec_f["lcnt"][s] = left[2]
+                rec_f["rcnt"][s] = right[2]
+                rec_f["internal_value"][s] = leaf_value[bl]
+                leaf_sum[bl] = left
+                leaf_sum[rl] = right
+                leaf_value[bl] = lval
+                leaf_value[rl] = rval
+                leaf_cnt[bl] = left[2]
+                leaf_cnt[rl] = right[2]
+                leaf_depth[bl] = child_depth
+                leaf_depth[rl] = child_depth
+                leaf_rows[bl] = n_left
+                leaf_rows[rl] = n_right
+                store(bl, lres)
+                store(rl, rres)
+                num_splits += 1
+
+        self._trees_grown += 1
+        self._emit_stream_obs(stats0)
+        return GrowResult(
+            num_splits=np.int32(num_splits),
+            leaf_id=leaf_id,
+            leaf_value=leaf_value,
+            leaf_cnt=leaf_cnt,
+            rec_leaf=rec_i["leaf"], rec_feat=rec_i["feat"],
+            rec_thr=rec_i["thr"], rec_dbz=rec_i["dbz"],
+            rec_gain=rec_f["gain"], rec_lval=rec_f["lval"],
+            rec_rval=rec_f["rval"], rec_lcnt=rec_f["lcnt"],
+            rec_rcnt=rec_f["rcnt"],
+            rec_internal_value=rec_f["internal_value"],
+        )
+
+    # ------------------------------------------------------------------
+    def add_tree_scores(self, score_k, arrays):
+        """Streamed ``predict_binned`` over this rank's chunk grid."""
+        return self.folder.streamed_scores(score_k, arrays)
+
+    def _emit_stream_obs(self, before: dict) -> None:
+        # rank stamps ride on every record (tracer.set_identity), but
+        # the explicit attr keeps per-rank OOC gauges attributable even
+        # in single-process simulations (LocalComm) where no identity is
+        # set — `report merge` keys its OOC stall-share column on them
+        OocTrainer._emit_stream_obs(self, before, rank=self.comm.rank)

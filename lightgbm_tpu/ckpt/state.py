@@ -401,7 +401,17 @@ def restore(booster, state: TrainState) -> TrainState:
     ooc = getattr(b, "ooc", None)
     want_sched = state.meta.get("ooc_schedule")
     have_sched = ooc.schedule_fingerprint() if ooc is not None else None
-    if want_sched != have_sched:
+    if (isinstance(want_sched, str) and isinstance(have_sched, str)
+            and want_sched.startswith("dist/")
+            and have_sched.startswith("dist/")):
+        # rank-sharded streaming (boosting/oocdist.py): the schedule is
+        # per-RANK, so an elastic resume at a different world size
+        # legitimately streams a different local grid.  That is sound —
+        # quantized integer folds are associative and f32 folds stay
+        # ROW_BLOCK-aligned within each rank — and the GLOBAL dataset
+        # fingerprint above still gates the resume.
+        pass
+    elif want_sched != have_sched:
         raise CheckpointMismatch(
             "checkpoint out-of-core chunk schedule "
             f"{want_sched!r} != this run's {have_sched!r}; resuming "

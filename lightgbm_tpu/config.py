@@ -351,14 +351,20 @@ class Config:
         if self.top_k < 1:
             Log.fatal("top_k must be >= 1 for voting-parallel, got %d",
                       self.top_k)
-        if (learner == "voting"
+        if (learner in ("voting", "feature")
                 and str(self.out_of_core).lower() in ("true", "1", "on",
                                                       "yes")):
             Log.fatal(
-                "tree_learner=voting cannot run with out_of_core=true: "
-                "the voting learner's per-node elected-histogram exchange "
-                "needs the full resident bin matrix. Set out_of_core=false "
-                "(or auto) or switch to tree_learner=data.")
+                "tree_learner=%s cannot run with out_of_core=true: "
+                "the %s needs the full resident bin matrix. Streaming "
+                "supports tree_learner=serial (single process) or "
+                "tree_learner=data (each rank streams its own row "
+                "shard). Set out_of_core=false (or auto) or switch to "
+                "tree_learner=data.",
+                learner,
+                "voting learner's per-node elected-histogram exchange"
+                if learner == "voting"
+                else "feature-parallel learner's column blocks")
         if self.num_leaves < 2:
             Log.fatal("num_leaves must be >= 2, got %d", self.num_leaves)
         if not (0.0 < self.feature_fraction <= 1.0):
@@ -373,11 +379,16 @@ class Config:
             Log.fatal("out_of_core must be auto/true/false, got %s",
                       self.out_of_core)
         if self.ooc_chunk_rows < 0:
-            Log.fatal("ooc_chunk_rows must be >= 0, got %d",
-                      self.ooc_chunk_rows)
+            Log.fatal(
+                "ooc_chunk_rows must be >= 0 (0 = auto-size; any "
+                "positive value is rounded up to a ROW_BLOCK multiple, "
+                "per rank over that rank's shard rows under "
+                "tree_learner=data), got %d", self.ooc_chunk_rows)
         if self.ooc_prefetch_depth < 1:
-            Log.fatal("ooc_prefetch_depth must be >= 1, got %d",
-                      self.ooc_prefetch_depth)
+            Log.fatal(
+                "ooc_prefetch_depth must be >= 1 (chunks in flight in "
+                "each rank's prefetch ring), got %d",
+                self.ooc_prefetch_depth)
         if not (2 <= self.quantized_grad_bits <= 15):
             # >15 would let a single row overflow the int16 wire plane;
             # <2 leaves no signed levels at all

@@ -1,0 +1,192 @@
+"""ChunkSource: the streaming seam shared by every out-of-core learner.
+
+PR 8's ``boosting/ooc.py`` buried three reusable pieces inside its
+serial trainer: picking a chunk source for a dataset, running the
+prefetch ring over a chunk plan, and the per-chunk histogram fold /
+split-application loops.  This module hoists them into one seam so the
+serial OocTrainer and the distributed rank-sharded trainer
+(``boosting/oocdist.py``) consume the identical streaming machinery:
+
+  ``make_chunk_source``  dataset -> chunk source (CRC-checked binary
+                         cache via data/cache.py when the dataset was
+                         loaded from one, else the host/memmap array)
+  ``ChunkStream``        a (source, plan, depth, stats) bundle whose
+                         ``stream()`` runs the bounded prefetch ring of
+                         data/prefetch.py — one object owns a rank's
+                         whole streaming configuration
+  ``ChunkFolder``        the fold algebra over a ChunkStream: the root
+                         histogram fold, the one-pass split fold that
+                         partitions ``leaf_id`` and builds BOTH child
+                         histograms, the smaller-child-direct /
+                         larger-by-subtraction rule, and the streamed
+                         ``predict_binned`` score pass
+
+Bit-identity contract (inherited verbatim from boosting/ooc.py, whose
+parity suite pins it): with chunk boundaries on ``ROW_BLOCK`` multiples
+the f32 folds reproduce the in-memory scan's left-to-right block adds
+bit for bit, and integer (quantized-training) folds are associative —
+identical for ANY chunk grid and, summed across ranks, for ANY rank
+count.  The folder contains no cross-rank logic; distributed callers
+exchange its per-rank partials themselves (the fold algebra composes
+with allreduce exactly because the integer partials are associative).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops.histogram import ROW_BLOCK
+from ..ops.ooc import (
+    root_hist_chunk,
+    scatter_add_slice,
+    split_chunk,
+    subtract_sibling,
+)
+from ..ops.predict import predict_binned
+from .prefetch import (
+    ArrayChunkSource,
+    CacheChunkSource,
+    ChunkPlan,
+    ChunkPrefetcher,
+    PrefetchStats,
+)
+
+__all__ = [
+    "ArrayChunkSource",
+    "CacheChunkSource",
+    "ChunkFolder",
+    "ChunkPlan",
+    "ChunkStream",
+    "PrefetchStats",
+    "make_chunk_source",
+]
+
+
+def make_chunk_source(train_set):
+    """Chunk source for a constructed dataset: prefer checksummed reads
+    straight from the v2 binary cache the dataset was loaded from; any
+    other dataset streams from its host (or memmapped) ``binned``
+    array."""
+    path = getattr(train_set, "cache_path", None)
+    if path:
+        from .cache import open_cache_reader
+
+        reader = open_cache_reader(path)
+        if reader is not None:
+            return CacheChunkSource(reader)
+    return ArrayChunkSource(np.asarray(train_set.binned))
+
+
+class ChunkStream:
+    """One rank's streaming configuration: a chunk source, the grid over
+    its rows, the prefetch depth, and the accumulated overlap stats.
+
+    ``stream()`` yields ``(index, start, stop, device_chunk)`` in
+    schedule order through the bounded prefetch ring; every pass shares
+    ``stats`` so fetch/stall accounting accumulates across trees."""
+
+    def __init__(self, source, plan: ChunkPlan, depth: int = 2,
+                 stats: PrefetchStats | None = None):
+        self.source = source
+        self.plan = plan
+        self.depth = max(int(depth), 1)
+        self.stats = stats if stats is not None else PrefetchStats()
+
+    def stream(self):
+        return ChunkPrefetcher(self.source, self.plan, self.depth,
+                               self.stats).stream()
+
+    def describe(self) -> str:
+        return self.source.describe()
+
+    def fingerprint(self) -> str:
+        return self.plan.fingerprint()
+
+
+class ChunkFolder:
+    """The per-chunk fold algebra over a :class:`ChunkStream`.
+
+    Stateless beyond its (stream, shapes) configuration: every method
+    takes the device-resident row vectors and returns fresh carries, so
+    serial and distributed trainers replay their host-driven loops
+    through the same folds.  ``quantized`` folds (integer grad/hess)
+    produce exact int32 partials; f32 folds keep the ROW_BLOCK-aligned
+    block-add order."""
+
+    def __init__(self, stream: ChunkStream, num_features: int,
+                 num_bins: int, row_block: int = ROW_BLOCK):
+        self.stream = stream
+        self.num_features = int(num_features)
+        self.num_bins = int(num_bins)
+        self.row_block = int(row_block)
+
+    def fold_root(self, grad, hess, select):
+        """One streamed pass folding every chunk into the root
+        histogram; (F, B, 3) int32 under integer gradients, f32
+        otherwise (matching ``build_histogram``'s in-memory dtypes)."""
+        import jax.numpy as jnp
+
+        quant = jnp.issubdtype(grad.dtype, jnp.integer)
+        hist = jnp.zeros((self.num_features, self.num_bins, 3),
+                         jnp.int32 if quant else jnp.float32)
+        for _i, start, _stop, chunk in self.stream.stream():
+            hist = root_hist_chunk(hist, chunk, grad, hess, select,
+                                   np.int32(start), self.num_bins,
+                                   self.row_block)
+        return hist
+
+    def fold_split(self, leaf_id, parent_hist, grad, hess, select, feat,
+                   zero_bin, dbz, thr, is_cat, bl, rl):
+        """One streamed pass applying one split: partition ``leaf_id``
+        by the split predicate and fold BOTH children's histogram
+        partials (2x flops for 1x transfer — transfers bound the
+        out-of-core regime).  Returns ``(leaf_id, hist_l, hist_r,
+        n_left)`` with ``n_left`` the (local) left-row count."""
+        import jax.numpy as jnp
+
+        hist_l = jnp.zeros_like(parent_hist)
+        hist_r = jnp.zeros_like(parent_hist)
+        n_left = jnp.zeros((), jnp.int32)
+        for _i, start, _stop, chunk in self.stream.stream():
+            leaf_id, hist_l, hist_r, n_left = split_chunk(
+                leaf_id, hist_l, hist_r, n_left, chunk, grad, hess,
+                select, np.int32(start), np.int32(feat),
+                np.int32(zero_bin), np.int32(dbz), np.int32(thr),
+                bool(is_cat), np.int32(bl), np.int32(rl), self.num_bins,
+                self.row_block,
+            )
+        return leaf_id, hist_l, hist_r, n_left
+
+    @staticmethod
+    def pick_children(parent_hist, hist_l, hist_r, n_left: int,
+                      n_right: int):
+        """The smaller-child-direct / larger-by-subtraction rule
+        (FeatureHistogram::Subtract): keep the DIRECT accumulation for
+        the smaller child and derive the larger as parent - smaller,
+        matching the in-memory grower's numerics.  ``n_left``/``n_right``
+        are the row counts the rule keys on — LOCAL rows for a serial
+        trainer, GLOBAL rows for a distributed one (every rank must pick
+        the same child).  Returns ``(left_hist, right_hist)``."""
+        if n_left < n_right:
+            return hist_l, subtract_sibling(parent_hist, hist_l)
+        return subtract_sibling(parent_hist, hist_r), hist_r
+
+    def streamed_scores(self, score_k, arrays):
+        """Streamed ``predict_binned`` over the chunk grid: the
+        rollback / DART score path when the matrix is not
+        device-resident.  The traversal is per-row, so chunking is
+        exact."""
+        for _i, start, _stop, chunk in self.stream.stream():
+            delta = predict_binned(
+                chunk,
+                arrays["split_feature_inner"],
+                arrays["threshold_bin"],
+                arrays["zero_bin"],
+                arrays["default_bin_for_zero"],
+                arrays["is_categorical"],
+                arrays["left_child"],
+                arrays["right_child"],
+                arrays["leaf_value"],
+            )
+            score_k = scatter_add_slice(score_k, delta, np.int32(start))
+        return score_k

@@ -420,6 +420,26 @@ def merge_summary(by_rank: Dict[int, List[Dict[str, Any]]]) -> Dict[str, Any]:
         if qw is not None:
             per_rank[rank]["hist_q_bytes"] = qw["hist_q_bytes"]
             per_rank[rank]["quantized_ratio"] = qw["ratio"]
+        # out-of-core streaming accounting (boosting/ooc.py gauges): how
+        # long this rank's folds sat stalled on its prefetch ring —
+        # attributes streaming stragglers the way barrier_wait_s
+        # attributes compute stragglers
+        ooc_stall = ooc_fetch = 0.0
+        saw_ooc = False
+        for r in by_rank[rank]:
+            if r.get("ev") != "gauge":
+                continue
+            if r.get("name") == "ooc.stall_ms":
+                ooc_stall += float(r.get("value", 0.0))
+                saw_ooc = True
+            elif r.get("name") == "ooc.fetch_ms":
+                ooc_fetch += float(r.get("value", 0.0))
+                saw_ooc = True
+        if saw_ooc:
+            per_rank[rank]["ooc_stall_s"] = round(ooc_stall / 1e3, 6)
+            per_rank[rank]["ooc_fetch_s"] = round(ooc_fetch / 1e3, 6)
+            per_rank[rank]["ooc_stall_share"] = (
+                round(ooc_stall / (wall * 1e3), 4) if wall > 0 else None)
     out: Dict[str, Any] = {
         "ranks": ranks,
         "world_size": (sorted(worlds)[-1] if worlds else len(ranks)),
@@ -488,19 +508,30 @@ def render_merge(m: Dict[str, Any]) -> str:
         f"world={m['world_size']}, {m['aligned_iterations']} aligned "
         f"iteration(s){rid} ===")
     ranks = m["ranks"]
-    # quantized-wire column only when some rank exchanged histograms
+    # quantized-wire column only when some rank exchanged histograms;
+    # OOC stall column only when some rank streamed its bin matrix
     show_q = any("quantized_ratio" in m["per_rank"][r] for r in ranks)
+    show_ooc = any("ooc_stall_s" in m["per_rank"][r] for r in ranks)
     lines.append("")
     lines.append(f"{'rank':<8}{'iters':>7}{'wall_s':>10}{'compute_s':>11}"
-                 f"{'barrier_wait_s':>16}{'bytes/iter':>12}"
+                 f"{'barrier_wait_s':>16}"
+                 + (f"{'ooc_stall_s':>13}{'stall%':>8}" if show_ooc else "")
+                 + f"{'bytes/iter':>12}"
                  + (f"{'q_ratio':>9}" if show_q else ""))
     for r in ranks:
         pr = m["per_rank"][r]
         qr = pr.get("quantized_ratio")
+        os_ = pr.get("ooc_stall_s")
+        osh = pr.get("ooc_stall_share")
         lines.append(f"{r:<8}{pr['aligned_iterations']:>7}"
                      f"{pr['wall_s']:>10.3f}{pr['compute_s']:>11.3f}"
                      f"{pr['barrier_wait_s']:>16.3f}"
-                     f"{pr.get('bytes_per_iter', 0.0):>12.0f}"
+                     + (((f"{os_:>13.3f}" if os_ is not None
+                          else f"{'-':>13}")
+                         + (f"{100.0 * osh:>7.1f}%" if osh is not None
+                            else f"{'-':>8}"))
+                        if show_ooc else "")
+                     + f"{pr.get('bytes_per_iter', 0.0):>12.0f}"
                      + ((f"{qr:>9.2f}" if qr is not None else f"{'-':>9}")
                         if show_q else ""))
     st = m.get("straggler")

@@ -357,6 +357,61 @@ def test_elastic_section_error_never_gates(tmp_path):
     assert "regression_elastic_recovery" not in out
 
 
+def _oocdist(parity=True):
+    return {
+        "rows": 16384, "trees": 3, "ranks": 2,
+        "chunk_grids": [2048, 9999],
+        "chunks_per_pass": {2048: 2, 9999: 1},
+        "fleet_wall_s": {2048: 21.0, 9999: 19.5},
+        "quantized_parity_ok": parity,
+    }
+
+
+def test_oocdist_gate_fires_on_parity_break(tmp_path):
+    """Quantized streamed folds are associative int32 adds, so the model
+    bytes must match EXACTLY across chunk grids — any mismatch gates
+    outright with no prior capture."""
+    out = {"metric": METRIC, "value": 0.10,
+           "ooc_distributed": _oocdist(parity=False)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 1
+    assert out["regression_oocdist_parity"] is True
+    assert out["gate_oocdist"]["require_quantized_parity"] is True
+    assert out["gate_oocdist"]["chunk_grids"] == [2048, 9999]
+
+
+def test_oocdist_gate_is_device_independent(tmp_path):
+    # parity is protocol arithmetic: it gates even on a
+    # backend_fallback / device_tunnel_dead capture that skips every
+    # wall-clock gate (ISSUE contract: gate OUTRIGHT on dead tunnels)
+    out = {"metric": METRIC, "value": 9.9, "backend_fallback": True,
+           "device_tunnel_dead": True,
+           "ooc_distributed": _oocdist(parity=False)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 1
+    assert out["regression_oocdist_parity"] is True
+    assert "regression" not in out  # headline leg still skipped
+    out = {"metric": METRIC, "value": 9.9, "backend_fallback": True,
+           "ooc_distributed": _oocdist(parity=True)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+    assert "gate_oocdist" in out
+
+
+def test_oocdist_gate_passes(tmp_path):
+    out = {"metric": METRIC, "value": 0.10,
+           "ooc_distributed": _oocdist(parity=True)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+    assert out["gate_oocdist"]["quantized_parity_ok"] is True
+    for k in list(out):
+        assert not k.startswith("regression"), k
+
+
+def test_oocdist_section_error_never_gates(tmp_path):
+    out = {"metric": METRIC, "value": 0.10,
+           "ooc_distributed": {"error": "RuntimeError: fleet failed"}}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+    assert "gate_oocdist" not in out
+    assert "regression_oocdist_parity" not in out
+
+
 def test_comms_wall_gate_against_prior(tmp_path):
     _capture(tmp_path, "BENCH_r01.json", 0.10, comms=_comms(data_s=1.0))
     out = {"metric": METRIC, "value": 0.10,

@@ -1,0 +1,329 @@
+"""Distributed out-of-core training tests (boosting/oocdist.py,
+data/chunksource.py — docs/PARALLEL.md mode matrix, docs/DATA.md
+"Distributed streaming").
+
+The acceptance contract: a multi-rank subprocess world where every rank
+streams its OWN row shard through the prefetch ring trains successfully
+past each rank's device budget, and with ``quantized_training`` on the
+final model is BYTE-IDENTICAL across per-rank chunk grids and across
+world sizes (integer chunk folds are associative — PR 14's wire plus
+PR 8's streaming compose with zero exactness caveats).  A preempted
+4-rank fleet resumes from the canonical checkpoint at worlds 4 AND 2:
+the per-rank ``dist/`` chunk-schedule fingerprint is exempt from the
+serial grid-refusal, while the global dataset fingerprint still gates.
+
+Subprocess fleets reuse the elastic harness pattern of
+test_ckpt_fault.py with tests/oocdist_worker.py (world-invariant data
+recipe, contiguous pre-partitioned shards, whole-job SIGKILL
+preemption).
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "oocdist_worker.py")
+
+pytestmark = pytest.mark.oocdist
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _spawn_fleet(tag, world, ckdir="-", extra_env=None):
+    """Start one world-``world`` phase of the oocdist worker; returns
+    (out-prefix, procs) without waiting."""
+    port = _free_port()
+    base = {k: v for k, v in os.environ.items()
+            if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "LIGHTGBM_TPU_FAULT",
+                         "LIGHTGBM_TPU_FAULT_RANK", "LIGHTGBM_TPU_TRACE",
+                         "LIGHTGBM_TPU_AUDIT", "LIGHTGBM_TPU_OOC",
+                         "LIGHTGBM_TPU_DEVICE_BUDGET")}
+    base["PYTHONPATH"] = REPO + os.pathsep + base.get("PYTHONPATH", "")
+    base.update(extra_env or {})
+    procs = []
+    for r in range(world):
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER, str(r), str(world), str(port), tag,
+             "train", ckdir],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=dict(base)))
+    return tag, procs
+
+
+def _join_fleet(procs, timeout=600):
+    return [p.communicate(timeout=timeout)[0] for p in procs]
+
+
+def _result(out, rank):
+    with open(out + f".rank{rank}.json") as fh:
+        return json.load(fh)
+
+
+def _model(out, rank):
+    with open(out + f".rank{rank}.txt") as fh:
+        return fh.read()
+
+
+def _assert_clean(procs, logs):
+    assert all(p.returncode == 0 for p in procs), \
+        "\n".join(l[-4000:] for l in logs)
+
+
+# ======================================================================
+# tier-1 smoke: 2 ranks, device budget forced below each rank's shard
+# ======================================================================
+def test_two_rank_budget_smoke(tmp_path):
+    """A 2-rank world whose per-rank packed shard exceeds a forced
+    device budget auto-routes to the distributed streaming learner and
+    both ranks agree on the model bytes."""
+    out, procs = _spawn_fleet(
+        str(tmp_path / "smoke"), 2,
+        extra_env={"OOCDIST_ROWS": "2048", "OOCDIST_TREES": "3",
+                   "OOCDIST_OOC": "auto", "OOCDIST_QUANT": "1",
+                   "OOCDIST_LEAVES": "7",
+                   # 1024 rows/rank * 10 features * 1 B packed = 10240 B
+                   "LIGHTGBM_TPU_DEVICE_BUDGET": "4096"})
+    logs = _join_fleet(procs)
+    _assert_clean(procs, logs)
+    r0, r1 = _result(out, 0), _result(out, 1)
+    assert r0["ooc"] and r1["ooc"]
+    assert r0["learner"] == "DistributedOocTrainer"
+    assert r0["schedule"].startswith("dist/2w/r0/")
+    assert r1["schedule"].startswith("dist/2w/r1/")
+    assert _model(out, 0) == _model(out, 1)
+    assert r0["trees"] == 3
+
+
+# ======================================================================
+# the quantized byte-identity matrix: chunk grids x world sizes
+# ======================================================================
+def test_quantized_grid_world_parity(tmp_path):
+    """With quantized_training on, integer chunk folds are associative:
+    the model bytes are identical across per-rank chunk grids
+    {1000, 2048, 9999} AND across 2-vs-4 rank worlds.  16384 global
+    rows make the grids genuinely different plans at world 2 (1000 and
+    2048 round up to one 4096-row block grid = 2 chunks/rank; 9999
+    rounds to 12288 = 1 chunk/rank)."""
+    env = {"OOCDIST_ROWS": "16384", "OOCDIST_TREES": "3",
+           "OOCDIST_OOC": "true", "OOCDIST_QUANT": "1",
+           "OOCDIST_LEAVES": "7"}
+    fleets = []
+    for world, grid in ((2, 1000), (2, 2048), (2, 9999), (4, 2048)):
+        fleets.append((world, grid) + _spawn_fleet(
+            str(tmp_path / f"w{world}g{grid}"), world,
+            extra_env=dict(env, OOCDIST_CHUNK_ROWS=str(grid))))
+    models = {}
+    for world, grid, out, procs in fleets:
+        logs = _join_fleet(procs)
+        _assert_clean(procs, logs)
+        m = _model(out, 0)
+        assert all(_model(out, r) == m for r in range(world))
+        models[(world, grid)] = m
+        # the grids must be real: 2048 -> 2 chunks/rank at world 2,
+        # 9999 -> 1 (both stream, the plans differ)
+        chunks = _result(out, 0)["chunks_per_pass"]
+        if world == 2:
+            assert chunks == (1 if grid == 9999 else 2)
+    ref = models[(2, 1000)]
+    assert all(m == ref for m in models.values()), \
+        "quantized model bytes diverged across chunk grids/world sizes"
+
+
+# ======================================================================
+# elastic resume: preempted 4-rank fleet resumes at worlds 4 and 2
+# ======================================================================
+@pytest.mark.faultinject
+@pytest.mark.netfault
+def test_elastic_resume_worlds(tmp_path):
+    """A 4-rank streaming fleet SIGKILLed mid-run resumes from the
+    canonical checkpoint at world 4 AND world 2 — the resumed world-2
+    ranks stream a DIFFERENT per-rank grid (8192 rows/rank = 2 chunks
+    vs the checkpoint's 1), which the ``dist/`` schedule exemption
+    admits — and both final models are byte-identical to an unkilled
+    reference (quantized folds are associative; the rounding counter is
+    re-anchored on restore)."""
+    env = {"OOCDIST_ROWS": "16384", "OOCDIST_TREES": "6",
+           "OOCDIST_FREQ": "2", "OOCDIST_OOC": "true",
+           "OOCDIST_QUANT": "1", "OOCDIST_LEAVES": "7",
+           "OOCDIST_CHUNK_ROWS": "1000"}
+    ck = str(tmp_path / "ck")
+    ref_out, ref_procs = _spawn_fleet(
+        str(tmp_path / "ref"), 4, str(tmp_path / "ck_ref"), dict(env))
+    kill_out, kill_procs = _spawn_fleet(
+        str(tmp_path / "kill"), 4, ck,
+        dict(env, OOCDIST_KILL_ITER="5"))
+    ref_logs = _join_fleet(ref_procs)
+    kill_logs = _join_fleet(kill_procs)
+    _assert_clean(ref_procs, ref_logs)
+    ref_model = _model(ref_out, 0)
+    assert all(_model(ref_out, r) == ref_model for r in range(4))
+
+    assert all(p.returncode == -signal.SIGKILL for p in kill_procs), \
+        "\n".join(l[-2000:] for l in kill_logs)
+    assert not os.path.exists(kill_out + ".rank0.txt"), \
+        "killed run must not have produced a model"
+
+    resumes = []
+    for world in (4, 2):
+        ckw = str(tmp_path / f"ck_w{world}")
+        shutil.copytree(ck, ckw)
+        resumes.append((world,) + _spawn_fleet(
+            str(tmp_path / f"resume{world}"), world, ckw, dict(env)))
+    for world, out, procs in resumes:
+        logs = _join_fleet(procs)
+        _assert_clean(procs, logs)
+        for r in range(world):
+            res = _result(out, r)
+            assert res["resume_from"] == 4, res
+            assert res["learner"] == "DistributedOocTrainer"
+        assert all(_model(out, r) == ref_model for r in range(world)), \
+            f"world-{world} resume diverged from the reference"
+
+
+# ======================================================================
+# the at-scale leg: 4 ranks, dataset larger than any single rank budget
+# ======================================================================
+@pytest.mark.slow
+def test_four_rank_over_budget(tmp_path):
+    """65536 global rows at a 64 KiB per-rank device budget: every
+    rank's packed shard (163840 B) exceeds the budget, so no single
+    rank could hold even its own quarter resident — the fleet streams
+    and the ranks agree byte-for-byte."""
+    out, procs = _spawn_fleet(
+        str(tmp_path / "big"), 4,
+        extra_env={"OOCDIST_ROWS": "65536", "OOCDIST_TREES": "3",
+                   "OOCDIST_OOC": "auto", "OOCDIST_QUANT": "1",
+                   "OOCDIST_LEAVES": "15", "OOCDIST_CHUNK_ROWS": "2048",
+                   "LIGHTGBM_TPU_DEVICE_BUDGET": str(64 << 10)})
+    logs = _join_fleet(procs, timeout=900)
+    _assert_clean(procs, logs)
+    r0 = _result(out, 0)
+    assert r0["ooc"] and r0["learner"] == "DistributedOocTrainer"
+    assert r0["chunks_per_pass"] == 4  # 16384 rows/rank at 4096-row chunks
+    m = _model(out, 0)
+    assert all(_model(out, r) == m for r in range(4))
+
+
+# ======================================================================
+# in-process satellites: config surface, ckpt relaxation, report column
+# ======================================================================
+class TestConfigSurface:
+    def test_feature_plus_ooc_names_the_matrix(self):
+        from lightgbm_tpu import LightGBMError
+        from lightgbm_tpu.config import Config
+
+        with pytest.raises(LightGBMError,
+                           match="serial.*|tree_learner=data"):
+            Config.from_params({"tree_learner": "feature",
+                                "out_of_core": "true"})
+
+    def test_voting_plus_ooc_still_refused(self):
+        from lightgbm_tpu import LightGBMError
+        from lightgbm_tpu.config import Config
+
+        with pytest.raises(LightGBMError, match="tree_learner=data"):
+            Config.from_params({"tree_learner": "voting",
+                                "out_of_core": "true"})
+
+    def test_data_plus_ooc_is_accepted(self):
+        from lightgbm_tpu.config import Config
+
+        cfg = Config.from_params({"tree_learner": "data",
+                                  "out_of_core": "true",
+                                  "num_machines": 4})
+        assert cfg.is_parallel
+
+    def test_chunk_rows_message_names_distributed_rounding(self):
+        from lightgbm_tpu import LightGBMError
+        from lightgbm_tpu.config import Config
+
+        with pytest.raises(LightGBMError, match="per rank"):
+            Config.from_params({"ooc_chunk_rows": -1})
+
+
+class TestDistScheduleRelaxation:
+    def test_dist_fingerprints_exempt_from_grid_refusal(self):
+        """A ``dist/``-prefixed schedule on BOTH sides resumes across
+        differing per-rank grids; a serial mismatch still refuses."""
+        import lightgbm_tpu as lgb
+        from lightgbm_tpu.ckpt import CheckpointMismatch, capture, restore
+
+        rng = np.random.RandomState(3)
+        X = rng.randn(600, 8)
+        y = (X[:, 0] + 0.2 * rng.randn(600) > 0).astype(float)
+        P = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+             "out_of_core": "true", "ooc_chunk_rows": 512,
+             "min_data_in_leaf": 20}
+        bst = lgb.train(dict(P), lgb.Dataset(X, label=y, params=dict(P)),
+                        2, verbose_eval=False)
+        st = capture(bst)
+        ooc = bst.boosting.ooc
+
+        # serial mismatch: refused (the existing backstop)
+        st.meta["ooc_schedule"] = "999r/512c/2"
+        with pytest.raises(CheckpointMismatch, match="chunk schedule"):
+            restore(bst, st)
+
+        # dist-vs-dist mismatch: admitted (per-rank grids legitimately
+        # differ across world sizes)
+        st2 = capture(bst)
+        st2.meta["ooc_schedule"] = "dist/4w/r0/4096r/4096c/1"
+        ooc.schedule_fingerprint = lambda: "dist/2w/r0/8192r/4096c/2"
+        try:
+            restore(bst, st2)
+        finally:
+            del ooc.schedule_fingerprint
+
+        # dist checkpoint into a serial run: still refused
+        st3 = capture(bst)
+        st3.meta["ooc_schedule"] = "dist/4w/r0/4096r/4096c/1"
+        with pytest.raises(CheckpointMismatch, match="chunk schedule"):
+            restore(bst, st3)
+
+
+class TestReportOocColumn:
+    def _recs(self, rank, stall_ms, fetch_ms):
+        recs = [{"ev": "iter", "iter": 0, "wall_s": 2.0,
+                 "phases": {"tree": 1.0}, "net_bytes": 100.0,
+                 "rank": rank, "world": 2}]
+        recs.append({"ev": "gauge", "name": "ooc.stall_ms",
+                     "value": stall_ms, "rank": rank})
+        recs.append({"ev": "gauge", "name": "ooc.fetch_ms",
+                     "value": fetch_ms, "rank": rank})
+        return recs
+
+    def test_merge_carries_per_rank_stall_share(self):
+        from lightgbm_tpu.obs.report import merge_summary, render_merge
+
+        m = merge_summary({0: self._recs(0, 500.0, 900.0),
+                           1: self._recs(1, 40.0, 800.0)})
+        assert m["per_rank"][0]["ooc_stall_s"] == pytest.approx(0.5)
+        assert m["per_rank"][0]["ooc_stall_share"] == pytest.approx(0.25)
+        assert m["per_rank"][1]["ooc_stall_s"] == pytest.approx(0.04)
+        txt = render_merge(m)
+        assert "ooc_stall_s" in txt and "stall%" in txt
+
+    def test_column_absent_without_streaming(self):
+        from lightgbm_tpu.obs.report import merge_summary, render_merge
+
+        recs = [{"ev": "iter", "iter": 0, "wall_s": 1.0, "phases": {},
+                 "net_bytes": 0.0}]
+        m = merge_summary({0: list(recs)})
+        assert "ooc_stall_s" not in m["per_rank"][0]
+        assert "ooc_stall_s" not in render_merge(m)
