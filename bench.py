@@ -128,6 +128,20 @@ def apply_regression_gate(out: dict, bench_dir: str = None, env=None) -> int:
         if not od["quantized_parity_ok"]:
             out["regression_oocdist_parity"] = True
             rc = 1
+    # linear-tree leg, same regime: trees-to-matched-logloss is a
+    # quality-per-tree property of the fit math, not of the backend, so
+    # the >=20% fewer-trees contract (ratio <= 0.8) gates outright
+    # (docs/TREES.md)
+    lt = out.get("linear_tree") or {}
+    ratio_l = lt.get("trees_to_match_ratio")
+    if lt and not lt.get("error") and isinstance(ratio_l, (int, float)):
+        out["gate_linear_tree"] = {
+            "max_trees_to_match_ratio": 0.8,
+            "trees_to_match_ratio": round(float(ratio_l), 3),
+        }
+        if float(ratio_l) > 0.8:
+            out["regression_linear_tree"] = True
+            rc = 1
     if out.get("backend_fallback"):
         return rc
     best, src = best_prior_sec_per_iter(bench_dir, out.get("metric"))
@@ -476,6 +490,89 @@ def _bench_swap(packed, warmup_rows, n_swaps=5):
             "swap_new_compiles": int(new_compiles),
         }
     except Exception as e:  # pragma: no cover — swap must not kill bench
+        section["error"] = f"{type(e).__name__}: {e}"
+    return section
+
+
+def _bench_linear(X, y, base_params):
+    """linear_tree section (docs/TREES.md): trees-to-matched-quality A/B
+    against constant leaves, plus v3 linear-artifact serving rows/s.
+
+    Both boosters train the same rows/rounds; the A/B counts how many
+    linear trees reach the CONSTANT model's final validation logloss
+    (``Booster.predict(num_iteration=i)`` makes the scan free — no
+    retrains).  ``trees_to_match_ratio`` is the acceptance number: the
+    issue's contract is linear reaching constant quality with >=20%
+    fewer trees, so the regression gate fails any capture above 0.8 —
+    outright, the ratio is a quality-per-tree property of the math, not
+    of the backend.  BENCH_LINEAR=0 skips; BENCH_LINEAR_ROWS /
+    BENCH_LINEAR_ITERS resize."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serve.artifact import PackedPredictor, PredictorArtifact
+
+    section = {}
+    try:
+        rows = min(int(os.environ.get("BENCH_LINEAR_ROWS", 60_000)), len(X))
+        iters = int(os.environ.get("BENCH_LINEAR_ITERS", 60))
+        n_tr = int(rows * 0.8)
+        Xt, yt = X[:n_tr], y[:n_tr]
+        Xv, yv = X[n_tr:rows], y[n_tr:rows]
+        params = {k: v for k, v in base_params.items()
+                  if k not in ("tree_learner", "num_machines")}
+        params.update(objective="binary", verbose=-1)
+        section["rows"] = rows
+        section["iters"] = iters
+
+        def logloss(margin):
+            p = 1.0 / (1.0 + np.exp(-np.asarray(margin, np.float64)))
+            p = np.clip(p, 1e-15, 1 - 1e-15)
+            return float(-np.mean(yv * np.log(p)
+                                  + (1 - yv) * np.log(1 - p)))
+
+        t0 = time.time()
+        const = lgb.train(dict(params), lgb.Dataset(Xt, label=yt),
+                          num_boost_round=iters, verbose_eval=False)
+        section["const_train_s"] = round(time.time() - t0, 2)
+        target = logloss(const.predict(Xv, raw_score=True))
+        section["const_valid_logloss"] = round(target, 6)
+
+        t0 = time.time()
+        lin = lgb.train(dict(params, linear_tree=True, linear_lambda=0.01),
+                        lgb.Dataset(Xt, label=yt),
+                        num_boost_round=iters, verbose_eval=False)
+        section["linear_train_s"] = round(time.time() - t0, 2)
+        section["linear_valid_logloss"] = round(
+            logloss(lin.predict(Xv, raw_score=True)), 6)
+
+        matched = None
+        for i in range(1, iters + 1):
+            if logloss(lin.predict(Xv, raw_score=True,
+                                   num_iteration=i)) <= target:
+                matched = i
+                break
+        section["trees_to_match"] = matched
+        section["trees_to_match_ratio"] = round(
+            (matched if matched is not None else iters) / iters, 3)
+
+        # v3 bucketed serving throughput (the artifact the A/B winner
+        # actually ships): warm batch-2048 rows/s + compile accounting
+        from lightgbm_tpu.obs import compilewatch
+
+        packed = PackedPredictor(PredictorArtifact.from_booster(lin))
+        bs = min(2048, rows)
+        batch = np.ascontiguousarray(Xt[:bs], np.float64)
+        packed.predict(batch)  # warm the bucket
+        c0 = compilewatch.total_compiles()
+        lat = []
+        for _ in range(10):
+            t0 = time.time()
+            packed.predict(batch)
+            lat.append(time.time() - t0)
+        lat.sort()
+        section["serve_batch_rows"] = bs
+        section["serve_rows_per_s"] = round(bs / lat[len(lat) // 2], 1)
+        section["serve_new_compiles"] = compilewatch.total_compiles() - c0
+    except Exception as e:  # pragma: no cover — A/B must not kill bench
         section["error"] = f"{type(e).__name__}: {e}"
     return section
 
@@ -1873,6 +1970,13 @@ def main():
     # swap compile count — its own regression-gate leg
     if os.environ.get("BENCH_QUANT", "0" if backend_fallback else "1") != "0":
         out["quantized"] = _bench_quantized(booster, X)
+
+    # linear-tree section (docs/TREES.md): trees-to-matched-logloss A/B
+    # vs constant leaves + v3 serving rows/s.  Runs even on
+    # backend_fallback: the fewer-trees ratio is quality-per-tree math,
+    # the device-independent leg of the regression gate.
+    if os.environ.get("BENCH_LINEAR", "1") != "0":
+        out["linear_tree"] = _bench_linear(X, y, params)
 
     # multi-model section (docs/SERVING.md): N=4 models bin-packed on
     # one chip behind named routes, per-model rows/s through the full

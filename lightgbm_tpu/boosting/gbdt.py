@@ -177,6 +177,13 @@ class GBDT:
         self.num_bins = int(train_set.max_num_bin)
         self.meta = FeatureMeta.from_dataset(train_set)
         self.hyper = SplitHyper.from_config(config)
+        # composable trainer core (tree/strategy.py): built AFTER the
+        # quantized-headroom check above so the strategy reflects any
+        # capability decline; rides GrowParams as a static (hashable)
+        # field, so every learner picks plug-ins up through one seam
+        from ..tree.strategy import TreeStrategy
+
+        self.strategy = TreeStrategy.from_config(config, train_set)
         self.grow_params = GrowParams(
             num_leaves=config.num_leaves,
             num_bins=self.num_bins,
@@ -186,7 +193,14 @@ class GBDT:
             quantized=config.quantized_training,
             quant_bits=config.quantized_grad_bits,
             quant_seed=config.seed,
+            strategy=self.strategy,
         )
+        # linear-tree state (tree/linear.py plug-in): the bin-value LUT
+        # is built lazily on first fit; _linear_k pins the coefficient
+        # width so every per-tree fit compiles one program shape
+        self._value_lut = None
+        self._linear_cat = None
+        self._linear_k = None
         # tree-learner dispatch (TreeLearner::CreateTreeLearner,
         # tree_learner.cpp:9-33): serial on one chip, or a sharded learner
         # over the device mesh
@@ -360,17 +374,7 @@ class GBDT:
                     [i * k + kk for i in range(len(self.models) // k)]
                 )
                 vs = vs.at[kk].add(
-                    predict_binned(
-                        vb,
-                        arrays["split_feature_inner"][idx],
-                        arrays["threshold_bin"][idx],
-                        arrays["zero_bin"][idx],
-                        arrays["default_bin_for_zero"][idx],
-                        arrays["is_categorical"][idx],
-                        arrays["left_child"][idx],
-                        arrays["right_child"][idx],
-                        arrays["leaf_value"][idx],
-                    )
+                    self._predict_binned_arrays(vb, arrays, idx)
                 )
         self.valid_scores.append(vs)
         self.valid_metrics.append(list(valid_metrics))
@@ -528,16 +532,37 @@ class GBDT:
                     should_continue = True
                     leaves_grown += num_splits + 1
                     tree = Tree.from_grow_result(gr, self.train_set)
+                    lin_fi = lin_fv = None
+                    if self.strategy.leaf_fit.linear:
+                        # fit BEFORE shrinkage: the ridge solve targets
+                        # the unshrunk gradients; shrinkage then scales
+                        # coefficients and constant together
+                        lin_fi, lin_fv = self._fit_linear_tree(
+                            tree, gr, gk, hk)
                     tree.shrinkage(self.shrinkage_rate)
                     audit.record_tree(self.iter, k, gr, tree)
+                    if self.strategy.split_gain.constrained:
+                        # splits on constrained features ran the
+                        # clipped-output gain path (ops/split.py)
+                        mono_t = self.strategy.split_gain.monotone
+                        rf = np.asarray(gr.rec_feat[:num_splits])
+                        tracer.counter(
+                            "tree.monotone_clip",
+                            float(sum(1 for f in rf
+                                      if mono_t[int(f)] != 0)))
                     with timetag.phase("train_score"):
                         # score update via the grower's partition (one gather)
                         lv = np.zeros(self.grow_params.num_leaves, np.float32)
                         lv[: tree.num_leaves] = tree.leaf_value[: tree.num_leaves]
                         leaf_vals = jnp.asarray(lv)
-                        self.scores = self.scores.at[k].set(
-                            add_leaf_outputs(self.scores[k], gr.leaf_id, leaf_vals)
-                        )
+                        if tree.is_linear and tree.leaf_is_linear[
+                                : tree.num_leaves].any():
+                            self._add_linear_train_scores(
+                                tree, gr, k, lin_fi, lin_fv, leaf_vals)
+                        else:
+                            self.scores = self.scores.at[k].set(
+                                add_leaf_outputs(self.scores[k], gr.leaf_id, leaf_vals)
+                            )
                         fence(self.scores)
                     with timetag.phase("valid_score"):
                         self._add_tree_to_valid_scores(tree, k)
@@ -702,17 +727,7 @@ class GBDT:
         arrays = stack_trees(trees)
         for i, vb in enumerate(self.valid_bins):
             self.valid_scores[i] = self.valid_scores[i].at[k].add(
-                predict_binned(
-                    vb,
-                    arrays["split_feature_inner"],
-                    arrays["threshold_bin"],
-                    arrays["zero_bin"],
-                    arrays["default_bin_for_zero"],
-                    arrays["is_categorical"],
-                    arrays["left_child"],
-                    arrays["right_child"],
-                    arrays["leaf_value"],
-                )
+                self._predict_binned_arrays(vb, arrays)
             )
 
     def _add_tree_to_train_scores(self, tree: Tree, k: int) -> None:
@@ -722,23 +737,137 @@ class GBDT:
         if self.bins is None:
             # out-of-core: traversal is per-row, so streaming it over the
             # chunk grid is exact
+            if "leaf_feat_inner" in arrays:
+                arrays = dict(arrays)
+                arrays["value_lut"] = self._linear_lut()[0]
             self.scores = self.scores.at[k].set(
                 self.ooc.add_tree_scores(self.scores[k], arrays)
             )
             return
         self.scores = self.scores.at[k].add(
-            predict_binned(
-                self.bins,
-                arrays["split_feature_inner"],
-                arrays["threshold_bin"],
-                arrays["zero_bin"],
-                arrays["default_bin_for_zero"],
-                arrays["is_categorical"],
-                arrays["left_child"],
-                arrays["right_child"],
-                arrays["leaf_value"],
-            )
+            self._predict_binned_arrays(self.bins, arrays)
         )
+
+    # -- linear-leaf plug-in (tree/linear.py LeafFit strategy) ---------
+    def _linear_lut(self):
+        """Cached ``(value_lut, is_categorical)`` pair: the (F, B) f32
+        bin-representative table every linear fit/score path shares, and
+        the per-inner-feature categorical mask that keeps categorical
+        splits out of leaf models."""
+        if self._value_lut is None:
+            from ..io.binning import CATEGORICAL
+            from ..tree.linear import build_value_lut
+
+            self._value_lut = jnp.asarray(
+                build_value_lut(self.train_set, self.num_bins))
+            self._linear_cat = np.asarray(
+                [m.bin_type == CATEGORICAL
+                 for m in self.train_set.bin_mappers], bool)
+        return self._value_lut, self._linear_cat
+
+    def _linear_kmax(self) -> int:
+        """Pinned coefficient width: every per-tree fit pads its path
+        planes to this k, so the batched Cholesky (and the OOC stats
+        fold) compiles exactly one program shape per training run."""
+        if self._linear_k is None:
+            num_numerical = int((~self._linear_lut()[1]).sum())
+            k = min(self.grow_params.num_leaves - 1, num_numerical)
+            if self.config.max_depth > 0:
+                k = min(k, self.config.max_depth)
+            self._linear_k = max(k, 1)
+        return self._linear_k
+
+    def _fit_linear_tree(self, tree: Tree, gr, gk, hk):
+        """Fit per-leaf ridge models for a freshly-grown tree (BEFORE
+        shrinkage): accumulate the (L, k+1, k+1) normal equations over
+        the selected rows, solve as one batched Cholesky, and attach the
+        models to ``tree``.  Returns the packed (L, k) device path
+        planes so the train-score update reuses them."""
+        from ..tree.linear import (leaf_path_features, linear_fit_stats,
+                                   pack_path_features, solve_linear_leaves)
+
+        lut, is_cat = self._linear_lut()
+        L = self.grow_params.num_leaves
+        with tracer.span("tree.leaf_fit", leaves=tree.num_leaves):
+            paths = leaf_path_features(gr, is_cat)
+            fi, fv = pack_path_features(paths, L,
+                                        k_max=self._linear_kmax())
+            fi_d = jnp.asarray(fi)
+            fv_d = jnp.asarray(fv)
+            if self.bins is None:
+                a, b = self.ooc.folder.fold_linear_stats(
+                    gk, hk, self.select, gr.leaf_id, fi_d, fv_d, lut, L)
+            else:
+                a, b = linear_fit_stats(
+                    self.bins, gk, hk, self.select, gr.leaf_id, fi_d,
+                    fv_d, lut, L)
+            w, ok = solve_linear_leaves(
+                a, b, fv_d, gr.leaf_cnt,
+                jnp.float32(self.strategy.leaf_fit.linear_lambda),
+                jnp.float32(self.hyper.lambda_l2))
+            w = np.asarray(w)
+            tree.set_linear_models(paths, w[:, 1:], w[:, 0],
+                                   np.asarray(ok), self.train_set)
+        return fi_d, fv_d
+
+    def _add_linear_train_scores(self, tree: Tree, gr, k: int, fi, fv,
+                                 leaf_vals) -> None:
+        """Train-score update for a linear tree via the grower's
+        partition: linear leaves evaluate their (shrunk) model at the
+        bin-representative values, constant-fallback leaves add the
+        classic leaf output (``leaf_vals`` is the padded fallback
+        plane)."""
+        from ..tree.linear import linear_leaf_scores
+
+        lut = self._linear_lut()[0]
+        L, kw = fi.shape
+        coeff = np.zeros((L, kw), np.float32)
+        const = np.zeros(L, np.float32)
+        isl = np.zeros(L, bool)
+        for i in range(tree.num_leaves):
+            if tree.leaf_is_linear[i]:
+                cs = tree.leaf_coeff[i]
+                coeff[i, : len(cs)] = cs
+                const[i] = tree.leaf_const[i]
+                isl[i] = True
+        coeff_d = jnp.asarray(coeff)
+        const_d = jnp.asarray(const)
+        isl_d = jnp.asarray(isl)
+        if self.bins is None:
+            self.scores = self.scores.at[k].set(
+                self.ooc.folder.fold_linear_scores(
+                    self.scores[k], gr.leaf_id, fi, fv, coeff_d,
+                    const_d, leaf_vals, isl_d, lut)
+            )
+            return
+        self.scores = self.scores.at[k].add(
+            linear_leaf_scores(self.bins, gr.leaf_id, fi, fv, coeff_d,
+                               const_d, leaf_vals, isl_d, lut)
+        )
+
+    def _predict_binned_arrays(self, bins, arrays, idx=None):
+        """Stacked-tree binned scoring, routed through the linear
+        traversal when the stack carries linear-leaf planes
+        (model/ensemble.py emits them only then) — constant ensembles
+        keep the exact pre-strategy ``predict_binned`` dispatch."""
+        def sel(name):
+            a = arrays[name]
+            return a if idx is None else a[idx]
+
+        planes = (
+            sel("split_feature_inner"), sel("threshold_bin"),
+            sel("zero_bin"), sel("default_bin_for_zero"),
+            sel("is_categorical"), sel("left_child"),
+            sel("right_child"), sel("leaf_value"),
+        )
+        if "leaf_feat_inner" not in arrays:
+            return predict_binned(bins, *planes)
+        from ..tree.linear import predict_linear_binned
+
+        return predict_linear_binned(
+            bins, *planes, sel("leaf_feat_inner"), sel("leaf_feat_valid"),
+            sel("leaf_coeff"), sel("leaf_const"), sel("leaf_is_linear"),
+            self._linear_lut()[0])
 
     def rollback_one_iter(self) -> None:
         """GBDT::RollbackOneIter (gbdt.cpp:497-514)."""
@@ -752,17 +881,7 @@ class GBDT:
             for i in range(len(self.valid_bins)):
                 arrays = stack_trees([tree])
                 self.valid_scores[i] = self.valid_scores[i].at[tree_id].add(
-                    predict_binned(
-                        self.valid_bins[i],
-                        arrays["split_feature_inner"],
-                        arrays["threshold_bin"],
-                        arrays["zero_bin"],
-                        arrays["default_bin_for_zero"],
-                        arrays["is_categorical"],
-                        arrays["left_child"],
-                        arrays["right_child"],
-                        arrays["leaf_value"],
-                    )
+                    self._predict_binned_arrays(self.valid_bins[i], arrays)
                 )
         del self.models[-k:]
         self.iter -= 1
@@ -1160,7 +1279,24 @@ class GBDT:
             return self._predict_raw_scores_unbucketed(data, models, k)
         from ..ops.qpredict import quant_predict_enabled
 
-        key = (len(models), k)
+        linear = any(getattr(t, "is_linear", False) for t in models)
+        key = (len(models), k, linear)
+        if linear:
+            # v3 linear-leaf serving path (serve/compilecache.py): the
+            # same bucket ladder, one extra coefficient gather per tree
+            if quant_predict_enabled():
+                Log.warning(
+                    "LIGHTGBM_TPU_QUANT_PREDICT=1 ignored: quantized "
+                    "serving does not support linear-leaf models; "
+                    "serving exact")
+            cached = getattr(self, "_bucketed_predictor", None)
+            if cached is None or cached[0] != key:
+                from ..serve.compilecache import BucketedLinearRawPredictor
+
+                cached = (key,
+                          BucketedLinearRawPredictor.from_models(models, k))
+                self._bucketed_predictor = cached
+            return cached[1].predict_raw_scores(np.asarray(data, np.float64))
         if quant_predict_enabled():
             # LIGHTGBM_TPU_QUANT_PREDICT=1: int16 rank-quantized
             # traversal (ops/qpredict.py) — route decisions are exact,
@@ -1195,28 +1331,40 @@ class GBDT:
         data_lo = jnp.asarray(lo)
         data_lo2 = jnp.asarray(lo2)
         arrays = stack_trees(models)
+        linear = "leaf_feat_real" in arrays
+        if linear:
+            from ..ops.predict import predict_raw_linear
         out = np.zeros((k, n))
         for kk in range(k):
             idx = np.asarray([i for i in range(len(models)) if i % k == kk])
-            out[kk] = np.asarray(
-                predict_raw(
-                    data_hi,
-                    data_lo,
-                    data_lo2,
-                    arrays["split_feature"][idx],
-                    arrays["threshold_real"][idx],
-                    arrays["threshold_real_lo"][idx],
-                    arrays["threshold_real_lo2"][idx],
-                    arrays["default_value"][idx],
-                    arrays["default_value_lo"][idx],
-                    arrays["default_value_lo2"][idx],
-                    arrays["is_categorical"][idx],
-                    arrays["left_child"][idx],
-                    arrays["right_child"][idx],
-                    arrays["leaf_value"][idx],
-                ),
-                np.float64,
+            raw_args = (
+                data_hi,
+                data_lo,
+                data_lo2,
+                arrays["split_feature"][idx],
+                arrays["threshold_real"][idx],
+                arrays["threshold_real_lo"][idx],
+                arrays["threshold_real_lo2"][idx],
+                arrays["default_value"][idx],
+                arrays["default_value_lo"][idx],
+                arrays["default_value_lo2"][idx],
+                arrays["is_categorical"][idx],
+                arrays["left_child"][idx],
+                arrays["right_child"][idx],
+                arrays["leaf_value"][idx],
             )
+            if linear:
+                scores = predict_raw_linear(
+                    *raw_args,
+                    arrays["leaf_feat_real"][idx],
+                    arrays["leaf_feat_valid"][idx],
+                    arrays["leaf_coeff"][idx],
+                    arrays["leaf_const"][idx],
+                    arrays["leaf_is_linear"][idx],
+                )
+            else:
+                scores = predict_raw(*raw_args)
+            out[kk] = np.asarray(scores, np.float64)
         return out
 
     def predict(self, data: np.ndarray, num_iteration: int = -1,

@@ -200,6 +200,19 @@ class OocTrainer:
         if quant and qscale is None:
             raise ValueError("integer grad/hess require the qscale argument")
         deq = (lambda h: dequantize_hist(h, qscale)) if quant else (lambda h: h)
+        # monotone-constraint strategy seam (tree/strategy.py): the
+        # host-driven replay carries per-leaf output bounds in the same
+        # np.float32 tables as the split state; unconstrained keeps the
+        # exact pre-strategy call graph (None kwargs)
+        mono_t = self.params.strategy.split_gain.monotone
+        use_mono = any(c != 0 for c in mono_t)
+        if use_mono and len(mono_t) != self.num_features:
+            raise ValueError(
+                f"monotone constraint vector has {len(mono_t)} entries "
+                f"but the dataset has {self.num_features} inner features")
+        mono = jnp.asarray(mono_t, jnp.int32) if use_mono else None
+        leaf_lo = np.full((self.params.num_leaves,), NEG_INF, np.float32)
+        leaf_hi = np.full((self.params.num_leaves,), np.inf, np.float32)
 
         with tracer.span("ooc.grow", tree=self._trees_grown,
                          chunks=self.plan.num_chunks):
@@ -210,8 +223,15 @@ class OocTrainer:
                 sums_dev = dequantize_sums(sums_dev, qscale)
             hist = self.folder.fold_root(grad, hess, select)
             root_sums = np.asarray(sums_dev, np.float32)
-            root_res = find_best_split(deq(hist), sums_dev, feature_mask,
-                                       True, meta, hyper, use_missing)
+            if use_mono:
+                root_res = find_best_split(
+                    deq(hist), sums_dev, feature_mask, True, meta, hyper,
+                    use_missing, monotone=mono,
+                    leaf_lo=leaf_lo[0], leaf_hi=leaf_hi[0])
+            else:
+                root_res = find_best_split(deq(hist), sums_dev,
+                                           feature_mask, True, meta,
+                                           hyper, use_missing)
 
             # host-side per-leaf tables (np.float32 throughout: any f64
             # promotion here would change the replayed arithmetic)
@@ -263,10 +283,29 @@ class OocTrainer:
                 dbz = int(bs_dbz[bl])
                 left = bs_left[bl].copy()
                 right = leaf_sum[bl] - left
-                lval_d, rval_d = child_leaf_values(
-                    left, right, hyper.lambda_l1, hyper.lambda_l2)
-                lval = np.float32(lval_d)
-                rval = np.float32(rval_d)
+                if use_mono:
+                    plo, phi = leaf_lo[bl], leaf_hi[bl]
+                    lval_d, rval_d = child_leaf_values(
+                        left, right, hyper.lambda_l1, hyper.lambda_l2,
+                        plo, phi)
+                    lval = np.float32(lval_d)
+                    rval = np.float32(rval_d)
+                    # BasicLeafConstraints mid-point tightening: splitting
+                    # a constrained feature bounds the children at the
+                    # midpoint of the two (clipped) outputs
+                    cdir = int(mono_t[feat])
+                    mid = np.float32((lval + rval) * np.float32(0.5))
+                    child_lhi = mid if cdir > 0 else phi
+                    child_llo = mid if cdir < 0 else plo
+                    child_rlo = mid if cdir > 0 else plo
+                    child_rhi = mid if cdir < 0 else phi
+                    leaf_lo[bl], leaf_hi[bl] = child_llo, child_lhi
+                    leaf_lo[rl], leaf_hi[rl] = child_rlo, child_rhi
+                else:
+                    lval_d, rval_d = child_leaf_values(
+                        left, right, hyper.lambda_l1, hyper.lambda_l2)
+                    lval = np.float32(lval_d)
+                    rval = np.float32(rval_d)
 
                 # ---- one streamed pass: partition + both children hists
                 leaf_id, hist_l, hist_r, n_left = self.folder.fold_split(
@@ -286,10 +325,22 @@ class OocTrainer:
                 child_depth = int(leaf_depth[bl]) + 1
                 depth_ok = (self.params.max_depth <= 0
                             or child_depth < self.params.max_depth)
-                lres = find_best_split(deq(left_hist), left, feature_mask,
-                                       depth_ok, meta, hyper, use_missing)
-                rres = find_best_split(deq(right_hist), right, feature_mask,
-                                       depth_ok, meta, hyper, use_missing)
+                if use_mono:
+                    lres = find_best_split(
+                        deq(left_hist), left, feature_mask, depth_ok,
+                        meta, hyper, use_missing, monotone=mono,
+                        leaf_lo=leaf_lo[bl], leaf_hi=leaf_hi[bl])
+                    rres = find_best_split(
+                        deq(right_hist), right, feature_mask, depth_ok,
+                        meta, hyper, use_missing, monotone=mono,
+                        leaf_lo=leaf_lo[rl], leaf_hi=leaf_hi[rl])
+                else:
+                    lres = find_best_split(deq(left_hist), left,
+                                           feature_mask, depth_ok, meta,
+                                           hyper, use_missing)
+                    rres = find_best_split(deq(right_hist), right,
+                                           feature_mask, depth_ok, meta,
+                                           hyper, use_missing)
 
                 rec_i["leaf"][s] = bl
                 rec_i["feat"][s] = feat

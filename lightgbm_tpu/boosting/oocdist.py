@@ -194,14 +194,26 @@ class DistributedOocTrainer:
         return self._merge_f32(blobs, (f, b, 3))
 
     def _find_best(self, local_hist, sums: np.ndarray, depth_ok: bool,
-                   feature_mask, meta, hyper):
+                   feature_mask, meta, hyper, monotone=None,
+                   leaf_lo=None, leaf_hi=None):
         """(gain, feat, thr, dbz, left(3,)) from the MERGED histogram —
-        identical on every rank, so the replayed loops stay lockstep."""
+        identical on every rank, so the replayed loops stay lockstep.
+        Monotone bounds are per-leaf host scalars every rank derives from
+        the same replay, so the constrained scan stays lockstep too."""
         ghist = self._global_hist(local_hist, float(sums[2]))
-        res = find_best_split(jnp.asarray(ghist),
-                              jnp.asarray(np.asarray(sums, np.float32)),
-                              feature_mask, bool(depth_ok), meta, hyper,
-                              self.params.use_missing)
+        if monotone is not None:
+            res = find_best_split(
+                jnp.asarray(ghist),
+                jnp.asarray(np.asarray(sums, np.float32)),
+                feature_mask, bool(depth_ok), meta, hyper,
+                self.params.use_missing, monotone=monotone,
+                leaf_lo=leaf_lo, leaf_hi=leaf_hi)
+        else:
+            res = find_best_split(
+                jnp.asarray(ghist),
+                jnp.asarray(np.asarray(sums, np.float32)),
+                feature_mask, bool(depth_ok), meta, hyper,
+                self.params.use_missing)
         left = np.asarray(
             [res.left_sum_g, res.left_sum_h, res.left_cnt], np.float32)
         return (np.float32(res.gain), int(res.feature),
@@ -247,6 +259,20 @@ class DistributedOocTrainer:
         del qscale  # quantizes internally; driver never passes one
         L = self.params.num_leaves
         stats0 = dict(self.stats.as_dict())
+        # monotone-constraint strategy seam (tree/strategy.py): bounds
+        # replay host-side exactly as in OocTrainer.grow — every rank
+        # derives identical np.float32 bounds from the lockstep replay,
+        # so no extra exchange is needed; unconstrained keeps the exact
+        # pre-strategy call graph (None kwargs)
+        mono_t = self.params.strategy.split_gain.monotone
+        use_mono = any(c != 0 for c in mono_t)
+        if use_mono and len(mono_t) != self.num_features:
+            raise ValueError(
+                f"monotone constraint vector has {len(mono_t)} entries "
+                f"but the dataset has {self.num_features} inner features")
+        mono = jnp.asarray(mono_t, jnp.int32) if use_mono else None
+        leaf_lo = np.full((L,), NEG_INF, np.float32)
+        leaf_hi = np.full((L,), np.inf, np.float32)
 
         if self.quant:
             # per-tree quantization: global scales from allgathered local
@@ -304,8 +330,15 @@ class DistributedOocTrainer:
                 bs_dbz[leaf] = np.int32(res[3])
                 bs_left[leaf] = res[4]
 
-            store(0, self._find_best(hist, root_sums, True, feature_mask,
-                                     meta, hyper))
+            if use_mono:
+                store(0, self._find_best(hist, root_sums, True,
+                                         feature_mask, meta, hyper,
+                                         monotone=mono,
+                                         leaf_lo=leaf_lo[0],
+                                         leaf_hi=leaf_hi[0]))
+            else:
+                store(0, self._find_best(hist, root_sums, True,
+                                         feature_mask, meta, hyper))
             pool = {0: hist}
             leaf_id = jnp.zeros((self.num_rows,), jnp.int32)
             default_bin = np.asarray(meta.default_bin)
@@ -324,10 +357,27 @@ class DistributedOocTrainer:
                 dbz = int(bs_dbz[bl])
                 left = bs_left[bl].copy()
                 right = leaf_sum[bl] - left
-                lval_d, rval_d = child_leaf_values(
-                    left, right, hyper.lambda_l1, hyper.lambda_l2)
-                lval = np.float32(lval_d)
-                rval = np.float32(rval_d)
+                if use_mono:
+                    plo, phi = leaf_lo[bl], leaf_hi[bl]
+                    lval_d, rval_d = child_leaf_values(
+                        left, right, hyper.lambda_l1, hyper.lambda_l2,
+                        plo, phi)
+                    lval = np.float32(lval_d)
+                    rval = np.float32(rval_d)
+                    # BasicLeafConstraints mid-point tightening
+                    cdir = int(mono_t[feat])
+                    mid = np.float32((lval + rval) * np.float32(0.5))
+                    child_lhi = mid if cdir > 0 else phi
+                    child_llo = mid if cdir < 0 else plo
+                    child_rlo = mid if cdir > 0 else plo
+                    child_rhi = mid if cdir < 0 else phi
+                    leaf_lo[bl], leaf_hi[bl] = child_llo, child_lhi
+                    leaf_lo[rl], leaf_hi[rl] = child_rlo, child_rhi
+                else:
+                    lval_d, rval_d = child_leaf_values(
+                        left, right, hyper.lambda_l1, hyper.lambda_l2)
+                    lval = np.float32(lval_d)
+                    rval = np.float32(rval_d)
 
                 # ---- one streamed pass: partition + both children hists
                 leaf_id, hist_l, hist_r, n_left = self.folder.fold_split(
@@ -353,10 +403,20 @@ class DistributedOocTrainer:
                 child_depth = int(leaf_depth[bl]) + 1
                 depth_ok = (self.params.max_depth <= 0
                             or child_depth < self.params.max_depth)
-                lres = self._find_best(left_hist, left, depth_ok,
-                                       feature_mask, meta, hyper)
-                rres = self._find_best(right_hist, right, depth_ok,
-                                       feature_mask, meta, hyper)
+                if use_mono:
+                    lres = self._find_best(
+                        left_hist, left, depth_ok, feature_mask, meta,
+                        hyper, monotone=mono, leaf_lo=leaf_lo[bl],
+                        leaf_hi=leaf_hi[bl])
+                    rres = self._find_best(
+                        right_hist, right, depth_ok, feature_mask, meta,
+                        hyper, monotone=mono, leaf_lo=leaf_lo[rl],
+                        leaf_hi=leaf_hi[rl])
+                else:
+                    lres = self._find_best(left_hist, left, depth_ok,
+                                           feature_mask, meta, hyper)
+                    rres = self._find_best(right_hist, right, depth_ok,
+                                           feature_mask, meta, hyper)
 
                 rec_i["leaf"][s] = bl
                 rec_i["feat"][s] = feat

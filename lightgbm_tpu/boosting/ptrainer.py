@@ -1525,6 +1525,14 @@ def eligible(config, train_set, objective, num_tree_per_iteration: int) -> bool:
     # an f32 pipeline and would break the exact-integer contract
     if getattr(config, "quantized_training", False):
         return False
+    # strategy plug-ins (tree/strategy.py): the fused kernels inline the
+    # unconstrained split scan and constant leaf outputs; linear leaves
+    # and monotone constraints run through the mask grower's strategy
+    # seam instead (same decline shape as quantization above)
+    if getattr(config, "linear_tree", False):
+        return False
+    if hasattr(config, "_monotone_active") and config._monotone_active():
+        return False
     if num_tree_per_iteration == 1:
         if not getattr(objective, "rowwise", False):
             return False

@@ -262,7 +262,50 @@ def pack_trees(models) -> Dict[str, np.ndarray]:
             src = getattr(tr, name)
             arr[i, : min(k, len(src))] = src[: min(k, len(src))]
         out["tree_" + name] = arr
+    if any(getattr(tr, "is_linear", False) for tr in models):
+        out.update(_pack_linear(models, t, li))
     return out
+
+
+def _pack_linear(models, t: int, li: int) -> Dict[str, np.ndarray]:
+    """Linear-leaf model planes (tree/linear.py plug-in) — emitted only
+    when at least one tree carries them, so constant-tree checkpoints
+    keep the exact pre-strategy key set (bit-identical containers)."""
+    kmax = 1
+    for tr in models:
+        if getattr(tr, "is_linear", False):
+            for fs in tr.leaf_features:
+                kmax = max(kmax, len(fs))
+    is_lin = np.zeros(t, np.int8)
+    const = np.zeros((t, li), np.float64)
+    leaf_lin = np.zeros((t, li), np.int8)
+    cnt = np.zeros((t, li), np.int32)
+    feat = np.zeros((t, li, kmax), np.int32)
+    feat_inner = np.zeros((t, li, kmax), np.int32)
+    coeff = np.zeros((t, li, kmax), np.float64)
+    for i, tr in enumerate(models):
+        if not getattr(tr, "is_linear", False):
+            continue
+        is_lin[i] = 1
+        n = tr.num_leaves
+        const[i, :n] = tr.leaf_const[:n]
+        leaf_lin[i, :n] = tr.leaf_is_linear[:n]
+        for lj in range(min(n, len(tr.leaf_features))):
+            fs = tr.leaf_features[lj]
+            cnt[i, lj] = len(fs)
+            if fs:
+                feat[i, lj, : len(fs)] = fs
+                feat_inner[i, lj, : len(fs)] = tr.leaf_features_inner[lj]
+                coeff[i, lj, : len(fs)] = tr.leaf_coeff[lj]
+    return {
+        "tree_is_linear": is_lin,
+        "tree_leaf_const": const,
+        "tree_leaf_is_linear": leaf_lin,
+        "tree_leaf_feat_cnt": cnt,
+        "tree_leaf_feat": feat,
+        "tree_leaf_feat_inner": feat_inner,
+        "tree_leaf_coeff": coeff,
+    }
 
 
 def unpack_trees(arrays: Dict[str, np.ndarray]):
@@ -282,6 +325,26 @@ def unpack_trees(arrays: Dict[str, np.ndarray]):
             dst[: len(src)] = src
         tree.shrinkage_rate = float(shrinkage[i])
         tree.has_categorical = bool(np.any(tree.decision_type[: max(n - 1, 1)] == 1))
+        if "tree_is_linear" in arrays and int(arrays["tree_is_linear"][i]):
+            tree.is_linear = True
+            tree.leaf_const[:n] = np.asarray(
+                arrays["tree_leaf_const"][i][:n], np.float64)
+            tree.leaf_is_linear[:n] = (
+                np.asarray(arrays["tree_leaf_is_linear"][i][:n]) != 0)
+            cnt = np.asarray(arrays["tree_leaf_feat_cnt"][i], np.int64)
+            tree.leaf_features = []
+            tree.leaf_features_inner = []
+            tree.leaf_coeff = []
+            for lj in range(n):
+                c = int(cnt[lj])
+                tree.leaf_features.append(
+                    tuple(int(v) for v in arrays["tree_leaf_feat"][i][lj][:c]))
+                tree.leaf_features_inner.append(
+                    tuple(int(v)
+                          for v in arrays["tree_leaf_feat_inner"][i][lj][:c]))
+                tree.leaf_coeff.append(
+                    tuple(np.asarray(arrays["tree_leaf_coeff"][i][lj][:c],
+                                     np.float64)))
         models.append(tree)
     return models
 

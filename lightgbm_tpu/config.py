@@ -103,6 +103,9 @@ PARAM_ALIASES: Dict[str, str] = {
     "unbalanced_sets": "is_unbalance",
     "bagging_fraction_seed": "bagging_seed",
     "use_quantized_grad": "quantized_training",
+    "linear_trees": "linear_tree",
+    "monotone_constraint": "monotone_constraints",
+    "mc": "monotone_constraints",
 }
 
 
@@ -206,6 +209,21 @@ class Config:
     # quantized_grad_bits: signed level width (2..15; 5 = QMAX 15).
     quantized_training: bool = False
     quantized_grad_bits: int = 5
+
+    # --- leaf-model / split-constraint plug-ins (tree/strategy.py;
+    # docs/TREES.md).  linear_tree fits per-leaf ridge least-squares
+    # models over each leaf's path features (tree/linear.py) with
+    # linear_lambda the ridge strength on the slope terms.
+    # monotone_constraints is a per-feature +1/0/-1 direction surface:
+    # a comma list ("+1,0,-1", one entry per raw feature) or a
+    # {feature index or name: direction} dict.  Supported matrix:
+    # linear_tree -> gbdt/goss boosting, f32 histograms,
+    # tree_learner=serial or data on ONE process (in-memory or
+    # out-of-core); monotone_constraints -> every learner except the
+    # fused ptrainer (which declines and falls back, like quantized).
+    linear_tree: bool = False
+    linear_lambda: float = 0.0
+    monotone_constraints: Any = ""
 
     # --- tree (TreeConfig, config.h:189–234)
     min_data_in_leaf: int = 20
@@ -319,6 +337,18 @@ class Config:
         if key == "label_gain":
             self.label_gain = _parse_list(value, float)
             return
+        if key == "monotone_constraints":
+            # two accepted forms (docs/TREES.md): comma list (one
+            # direction per raw feature) or {feature: direction} dict;
+            # python lists normalize to the comma form
+            if isinstance(value, dict):
+                self.monotone_constraints = dict(value)
+            elif isinstance(value, (list, tuple)):
+                self.monotone_constraints = ",".join(
+                    str(int(v)) for v in value)
+            else:
+                self.monotone_constraints = str(value)
+            return
         if not hasattr(self, key):
             Log.fatal("Unknown parameter: %s", key)
         cur = getattr(self, key)
@@ -333,6 +363,17 @@ class Config:
                 setattr(self, key, str(value))
         except (TypeError, ValueError):
             Log.fatal("Parameter %s received an unparsable value \"%s\"", key, value)
+
+    def _monotone_active(self) -> bool:
+        """True when monotone_constraints names at least one nonzero
+        direction (either surface form)."""
+        mc = self.monotone_constraints
+        if isinstance(mc, dict):
+            return any(int(v) != 0 for v in mc.values())
+        s = str(mc).strip()
+        if not s:
+            return False
+        return any(p.strip() not in ("", "0") for p in s.split(","))
 
     def _check_conflicts(self) -> None:
         """CheckParamConflict (config.cpp): parallel learners imply
@@ -394,6 +435,43 @@ class Config:
             # <2 leaves no signed levels at all
             Log.fatal("quantized_grad_bits must be in [2, 15], got %d",
                       self.quantized_grad_bits)
+        if self.linear_lambda < 0:
+            Log.fatal(
+                "linear_lambda must be >= 0 (ridge strength on the "
+                "linear-leaf slope terms), got %s", self.linear_lambda)
+        if self.linear_tree:
+            # supported matrix (docs/TREES.md): linear leaves need f32
+            # leaf sums and post-grow refits against the resident (or
+            # serially streamed) row shard of ONE process
+            matrix = ("linear_tree supports: boosting_type=gbdt/goss, "
+                      "quantized_training=false, tree_learner=serial or "
+                      "data on a single process (in-memory or "
+                      "out_of_core serial streaming)")
+            if self.quantized_training:
+                Log.fatal(
+                    "linear_tree=true cannot run with "
+                    "quantized_training=true: the per-leaf least-squares "
+                    "refit needs f32 gradient/hessian rows, not int16 "
+                    "levels. %s.", matrix)
+            if self.boosting_type.lower() == "dart":
+                Log.fatal(
+                    "linear_tree=true cannot run with boosting=dart: "
+                    "DART's per-tree drop/renormalize rescales leaf "
+                    "outputs after the fit, which would silently skew "
+                    "the fitted slopes. %s.", matrix)
+            if self.num_machines > 1:
+                Log.fatal(
+                    "linear_tree=true cannot run with num_machines=%d: "
+                    "the leaf refit solves against rows the coordinator "
+                    "does not hold. %s.", self.num_machines, matrix)
+        if self._monotone_active() and self.objective == "lambdarank":
+            Log.fatal(
+                "monotone_constraints cannot be combined with "
+                "objective=lambdarank: listwise rank gradients are not "
+                "per-row monotone in feature direction. Supported: "
+                "row-wise objectives (regression/binary/multiclass/"
+                "xentropy family) on every learner except the fused "
+                "ptrainer (which declines and falls back).")
         if self.network_timeout <= 0:
             Log.fatal("network_timeout must be > 0, got %s", self.network_timeout)
         if self.network_retries < 0:

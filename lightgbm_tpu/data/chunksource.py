@@ -175,18 +175,80 @@ class ChunkFolder:
         """Streamed ``predict_binned`` over the chunk grid: the
         rollback / DART score path when the matrix is not
         device-resident.  The traversal is per-row, so chunking is
-        exact."""
+        exact.  Stacked arrays carrying linear-leaf planes
+        (``leaf_feat_inner`` et al., model/ensemble.py) route through
+        the linear traversal; the linear term needs the bin-value LUT
+        under ``arrays["value_lut"]``."""
+        linear = "leaf_feat_inner" in arrays
+        if linear:
+            from ..tree.linear import predict_linear_binned
         for _i, start, _stop, chunk in self.stream.stream():
-            delta = predict_binned(
-                chunk,
-                arrays["split_feature_inner"],
-                arrays["threshold_bin"],
-                arrays["zero_bin"],
-                arrays["default_bin_for_zero"],
-                arrays["is_categorical"],
-                arrays["left_child"],
-                arrays["right_child"],
-                arrays["leaf_value"],
-            )
+            if linear:
+                delta = predict_linear_binned(
+                    chunk,
+                    arrays["split_feature_inner"],
+                    arrays["threshold_bin"],
+                    arrays["zero_bin"],
+                    arrays["default_bin_for_zero"],
+                    arrays["is_categorical"],
+                    arrays["left_child"],
+                    arrays["right_child"],
+                    arrays["leaf_value"],
+                    arrays["leaf_feat_inner"],
+                    arrays["leaf_feat_valid"],
+                    arrays["leaf_coeff"],
+                    arrays["leaf_const"],
+                    arrays["leaf_is_linear"],
+                    arrays["value_lut"],
+                )
+            else:
+                delta = predict_binned(
+                    chunk,
+                    arrays["split_feature_inner"],
+                    arrays["threshold_bin"],
+                    arrays["zero_bin"],
+                    arrays["default_bin_for_zero"],
+                    arrays["is_categorical"],
+                    arrays["left_child"],
+                    arrays["right_child"],
+                    arrays["leaf_value"],
+                )
+            score_k = scatter_add_slice(score_k, delta, np.int32(start))
+        return score_k
+
+    # -- linear-leaf folds (tree/linear.py LeafFit plug-in) -------------
+    def fold_linear_stats(self, grad, hess, select, leaf_id, feat_idx,
+                          feat_valid, value_lut, num_leaves: int):
+        """One streamed pass accumulating the per-leaf linear-fit normal
+        equations (A, b) — the out-of-core counterpart of
+        ``tree.linear.linear_fit_stats``.  Chunk boundaries differ from
+        the resident kernel's fixed row blocks, so the f32 add order may
+        differ (documented drift, docs/TREES.md); the fold body is the
+        SAME ``_fold_block`` both paths share."""
+        import jax.numpy as jnp
+
+        from ..tree.linear import linear_stats_chunk
+
+        k1 = feat_idx.shape[1] + 1
+        a = jnp.zeros((num_leaves, k1, k1), jnp.float32)
+        b = jnp.zeros((num_leaves, k1), jnp.float32)
+        for _i, start, _stop, chunk in self.stream.stream():
+            a, b = linear_stats_chunk(a, b, chunk, grad, hess, select,
+                                      leaf_id, np.int32(start), feat_idx,
+                                      feat_valid, value_lut)
+        return a, b
+
+    def fold_linear_scores(self, score_k, leaf_id, feat_idx, feat_valid,
+                           coeff, const, fallback, is_lin, value_lut):
+        """Streamed train-score update for one freshly-grown linear tree
+        via the grower's ``leaf_id`` partition (the out-of-core
+        counterpart of ``tree.linear.linear_leaf_scores``)."""
+        from ..tree.linear import linear_scores_chunk
+
+        for _i, start, _stop, chunk in self.stream.stream():
+            delta = linear_scores_chunk(chunk, leaf_id, np.int32(start),
+                                        feat_idx, feat_valid, coeff,
+                                        const, fallback, is_lin,
+                                        value_lut)
             score_k = scatter_add_slice(score_k, delta, np.int32(start))
         return score_k

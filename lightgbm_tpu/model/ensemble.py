@@ -82,7 +82,8 @@ def stack_trees(trees: List) -> dict:
 
     thr_hi, thr_lo, thr_lo2 = split_hi_lo(threshold_real)
     dv_hi, dv_lo, dv_lo2 = split_hi_lo(default_value)
-    return {
+    out = _linear_planes(trees, t, L)
+    out.update({
         "split_feature": jnp.asarray(split_feature),
         "split_feature_inner": jnp.asarray(split_feature_inner),
         "threshold_bin": jnp.asarray(threshold_bin),
@@ -98,4 +99,51 @@ def stack_trees(trees: List) -> dict:
         "left_child": jnp.asarray(left),
         "right_child": jnp.asarray(right),
         "leaf_value": jnp.asarray(leaf_value),
+    })
+    return out
+
+
+def _linear_planes(trees: List, t: int, L: int) -> dict:
+    """Linear-leaf coefficient planes (tree/linear.py plug-in), emitted
+    only when at least one tree carries linear leaf models so constant
+    ensembles keep the exact 15-array layout.  ``leaf_feat_inner``
+    drives binned traversal paths (training/valid scores, + the bin
+    value LUT), ``leaf_feat_real`` the raw serving gather; padded
+    coefficient slots are zero with ``leaf_feat_valid`` 0, so the
+    padded dot product is exact."""
+    if not any(getattr(tr, "is_linear", False) for tr in trees):
+        return {}
+    K = 1
+    for tr in trees:
+        if getattr(tr, "is_linear", False):
+            for fs in tr.leaf_features:
+                K = max(K, len(fs))
+    feat_inner = np.zeros((t, L, K), np.int32)
+    feat_real = np.zeros((t, L, K), np.int32)
+    feat_valid = np.zeros((t, L, K), np.float32)
+    coeff = np.zeros((t, L, K), np.float32)
+    const = np.zeros((t, L), np.float32)
+    is_lin = np.zeros((t, L), np.bool_)
+    for i, tr in enumerate(trees):
+        if not getattr(tr, "is_linear", False):
+            continue
+        n = max(tr.num_leaves, 1)
+        const[i, :n] = tr.leaf_const[:n]
+        is_lin[i, :n] = tr.leaf_is_linear[:n]
+        for li in range(min(n, len(tr.leaf_features))):
+            fs = tr.leaf_features[li]
+            if not fs or not tr.leaf_is_linear[li]:
+                continue
+            k = len(fs)
+            feat_real[i, li, :k] = fs
+            feat_inner[i, li, :k] = tr.leaf_features_inner[li]
+            feat_valid[i, li, :k] = 1.0
+            coeff[i, li, :k] = tr.leaf_coeff[li]
+    return {
+        "leaf_feat_inner": jnp.asarray(feat_inner),
+        "leaf_feat_real": jnp.asarray(feat_real),
+        "leaf_feat_valid": jnp.asarray(feat_valid),
+        "leaf_coeff": jnp.asarray(coeff),
+        "leaf_const": jnp.asarray(const),
+        "leaf_is_linear": jnp.asarray(is_lin),
     }

@@ -55,6 +55,15 @@ class Tree:
         self.internal_count = np.zeros(m, np.int64)
         self.shrinkage_rate = 1.0
         self.has_categorical = False
+        # piecewise-linear leaves (tree/linear.py plug-in); constant
+        # trees keep is_linear False and serialize byte-identically to
+        # the pre-plug-in format
+        self.is_linear = False
+        self.leaf_features: List[tuple] = []  # real feature idx per leaf
+        self.leaf_features_inner: List[tuple] = []
+        self.leaf_coeff: List[tuple] = []
+        self.leaf_const = np.zeros(max_leaves, np.float64)
+        self.leaf_is_linear = np.zeros(max_leaves, bool)
 
     # ------------------------------------------------------------------
     def split(
@@ -160,12 +169,39 @@ class Tree:
         return tree
 
     # ------------------------------------------------------------------
+    def set_linear_models(self, paths_inner, coeff, const, ok, dataset) -> None:
+        """Attach per-leaf linear models from the batched ridge solve
+        (tree/linear.py): ``coeff`` (L, k) slopes, ``const`` (L,)
+        intercepts, ``ok`` (L,) validity.  Leaves with ``ok`` False keep
+        the grower's constant ``leaf_value`` (fallback contract).  Call
+        BEFORE ``shrinkage`` so the learning rate scales both forms."""
+        n = self.num_leaves
+        coeff = np.asarray(coeff, np.float64)
+        const = np.asarray(const, np.float64)
+        ok = np.asarray(ok, bool)
+        self.is_linear = True
+        self.leaf_features_inner = []
+        self.leaf_features = []
+        self.leaf_coeff = []
+        for i in range(n):
+            path = tuple(paths_inner[i]) if ok[i] else ()
+            self.leaf_features_inner.append(path)
+            self.leaf_features.append(
+                tuple(dataset.inner_to_real_feature(f) for f in path))
+            self.leaf_coeff.append(tuple(coeff[i, : len(path)]))
+            self.leaf_is_linear[i] = ok[i] and len(path) > 0
+            self.leaf_const[i] = const[i] if self.leaf_is_linear[i] else 0.0
+
     def shrinkage(self, rate: float) -> None:
         """Tree::Shrinkage with the +-100 output clamp (tree.h:116-128)."""
         n = self.num_leaves
         self.leaf_value[:n] = np.clip(
             self.leaf_value[:n] * rate, -K_MAX_TREE_OUTPUT, K_MAX_TREE_OUTPUT
         )
+        if self.is_linear:
+            self.leaf_const[:n] *= rate
+            self.leaf_coeff = [tuple(c * rate for c in cs)
+                               for cs in self.leaf_coeff]
         self.shrinkage_rate *= rate
 
     # ------------------------------------------------------------------
@@ -179,25 +215,18 @@ class Tree:
         if self.num_leaves <= 1:
             out[:] = self.leaf_value[0]
             return out
-        node = np.zeros(n, np.int32)
-        active = node >= 0
-        while np.any(active):
-            j = np.where(active, node, 0)
-            fval = data[np.arange(n), self.split_feature[j]]
-            is_zero = (
-                ((fval > -MISSING_VALUE_RANGE) & (fval <= MISSING_VALUE_RANGE))
-                | np.isnan(fval)
-            )
-            fval = np.where(is_zero, self.default_value[j], fval)
-            goes_left = np.where(
-                self.decision_type[j] == 1,
-                fval.astype(np.int64) == self.threshold[j].astype(np.int64),
-                fval <= self.threshold[j],
-            )
-            nxt = np.where(goes_left, self.left_child[j], self.right_child[j])
-            node = np.where(active, nxt, node)
-            active = node >= 0
-        return self.leaf_value[~node]
+        leaf = self.predict_leaf_index(data)
+        out = self.leaf_value[leaf]
+        if self.is_linear:
+            for i in np.nonzero(self.leaf_is_linear[: self.num_leaves])[0]:
+                rows = np.nonzero(leaf == i)[0]
+                if rows.size == 0:
+                    continue
+                x = data[np.ix_(rows, np.asarray(self.leaf_features[i]))]
+                lin = self.leaf_const[i] + x @ np.asarray(self.leaf_coeff[i])
+                # a NaN path feature degrades that row to the constant
+                out[rows] = np.where(np.isfinite(lin), lin, out[rows])
+        return out
 
     def predict_leaf_index(self, data: np.ndarray) -> np.ndarray:
         from ..io.binning import MISSING_VALUE_RANGE
@@ -246,8 +275,22 @@ class Tree:
             "internal_count=" + _fmt(self.internal_count[:m], "%d"),
             f"shrinkage={self.shrinkage_rate:g}",
             f"has_categorical={1 if self.has_categorical else 0}",
-            "",
         ]
+        if self.is_linear:
+            # the reference's linear-tree block (tree.cpp ToString when
+            # linear_tree): per-leaf intercepts, path-feature counts,
+            # then flattened features/coefficients
+            counts = [len(self.leaf_features[i]) for i in range(n)]
+            flat_feat = [f for i in range(n) for f in self.leaf_features[i]]
+            flat_coef = [c for i in range(n) for c in self.leaf_coeff[i]]
+            lines += [
+                "is_linear=1",
+                "leaf_const=" + _fmt(self.leaf_const[:n], "%.17g"),
+                "num_features=" + _fmt(counts, "%d"),
+                "leaf_features=" + _fmt(flat_feat, "%d"),
+                "leaf_coeff=" + _fmt(flat_coef, "%.17g"),
+            ]
+        lines.append("")
         return "\n".join(lines) + "\n"
 
     @classmethod
@@ -292,6 +335,23 @@ class Tree:
         tree.has_categorical = bool(np.any(tree.decision_type[:m] == 1))
         if "shrinkage" in kv:
             tree.shrinkage_rate = float(kv["shrinkage"])
+        if int(kv.get("is_linear", "0")):
+            tree.is_linear = True
+            tree.leaf_const[:n] = arr("leaf_const", np.float64, n)
+            counts = arr("num_features", np.int64, n)
+            flat_feat = (np.array(kv["leaf_features"].split(), np.int64)
+                         if kv.get("leaf_features") else np.zeros(0, np.int64))
+            flat_coef = (np.array(kv["leaf_coeff"].split(), np.float64)
+                         if kv.get("leaf_coeff") else np.zeros(0))
+            off = 0
+            for i in range(n):
+                c = int(counts[i])
+                feats = tuple(int(f) for f in flat_feat[off:off + c])
+                tree.leaf_features.append(feats)
+                tree.leaf_features_inner.append(feats)
+                tree.leaf_coeff.append(tuple(flat_coef[off:off + c]))
+                tree.leaf_is_linear[i] = c > 0
+                off += c
         return tree
 
     # ------------------------------------------------------------------
@@ -311,17 +371,25 @@ class Tree:
                 "right_child": self._node_json(self.right_child[idx]),
             }
         leaf = ~idx
-        return {
+        node = {
             "leaf_index": int(leaf),
             "leaf_parent": int(self.leaf_parent[leaf]),
             "leaf_value": float(self.leaf_value[leaf]),
             "leaf_count": int(self.leaf_count[leaf]),
         }
+        if self.is_linear and self.leaf_is_linear[leaf]:
+            node["leaf_const"] = float(self.leaf_const[leaf])
+            node["leaf_features"] = [int(f) for f in self.leaf_features[leaf]]
+            node["leaf_coeff"] = [float(c) for c in self.leaf_coeff[leaf]]
+        return node
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "num_leaves": int(self.num_leaves),
             "shrinkage": float(self.shrinkage_rate),
             "has_categorical": 1 if self.has_categorical else 0,
             "tree_structure": self._node_json(0 if self.num_leaves > 1 else -1),
         }
+        if self.is_linear:
+            out["is_linear"] = 1
+        return out

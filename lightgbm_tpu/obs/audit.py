@@ -100,12 +100,28 @@ class AuditWriter:
                 "lcnt": row["lcnt"], "rcnt": row["rcnt"],
             }
             self._write(rec)
-        self._write({
+        rec = {
             "ev": "tree", "it": int(it), "k": int(k),
             "leaves": int(tree.num_leaves),
             "values": [float(v) for v in
                        tree.leaf_value[: tree.num_leaves]],
-        })
+        }
+        if getattr(tree, "is_linear", False):
+            # leaf-model kind + coefficients (tree/linear.py plug-in):
+            # json floats serialize via repr, so the trail is byte-stable
+            # across runs; constant trees keep the exact legacy record
+            n = tree.num_leaves
+            rec["leaf_model"] = "linear"
+            rec["linear_leaves"] = [int(v) for v in
+                                    tree.leaf_is_linear[:n]]
+            rec["const"] = [float(v) for v in tree.leaf_const[:n]]
+            rec["coeff"] = [[float(c) for c in tree.leaf_coeff[i]]
+                            if i < len(tree.leaf_coeff) else []
+                            for i in range(n)]
+            rec["feat"] = [list(tree.leaf_features[i])
+                           if i < len(tree.leaf_features) else []
+                           for i in range(n)]
+        self._write(rec)
 
 
 audit = AuditWriter()

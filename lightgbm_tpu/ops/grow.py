@@ -46,6 +46,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..tree.strategy import DEFAULT_STRATEGY, TreeStrategy
 from .histogram import ROW_BLOCK, build_histogram
 from .qhist import QUANT_BITS, dequantize_hist, dequantize_sums
 from .split import (
@@ -76,6 +77,11 @@ class GrowParams(NamedTuple):
     quantized: bool = False
     quant_bits: int = QUANT_BITS
     quant_seed: int = 0  # stochastic-rounding key base (config seed)
+    # composable trainer core (tree/strategy.py, docs/TREES.md): the
+    # strategy rides the static params so plug-ins (monotone directions,
+    # leaf-fit kind) are compile-time — the default strategy compiles
+    # the exact pre-strategy graph
+    strategy: TreeStrategy = DEFAULT_STRATEGY
 
 
 # Smallest compaction tier.  Below ~4x this, the masked full-scan is
@@ -156,6 +162,11 @@ class _State(NamedTuple):
     rec_lcnt: jnp.ndarray
     rec_rcnt: jnp.ndarray
     rec_internal_value: jnp.ndarray
+    # monotone-constraint output bounds per leaf (None when the
+    # strategy is unconstrained: None is an empty pytree, so the
+    # disabled while_loop state — and graph — is unchanged)
+    leaf_lo: jnp.ndarray = None
+    leaf_hi: jnp.ndarray = None
 
 
 def _store_split(st: _State, leaf, res) -> _State:
@@ -200,6 +211,17 @@ def grow_tree(
     B = params.num_bins
     mode = params.parallel
     ax = params.axis_name
+    # monotone plug-in (tree/strategy.py): the direction tuple is part
+    # of the static params, so the unconstrained default bakes NOTHING
+    # into the graph (mono stays None and every constraint branch below
+    # is dead Python, not masked XLA)
+    mono_t = params.strategy.split_gain.monotone
+    use_mono = any(c != 0 for c in mono_t)
+    if use_mono and len(mono_t) != f:
+        raise ValueError(
+            f"monotone direction vector has {len(mono_t)} entries for "
+            f"{f} features")
+    mono = jnp.asarray(mono_t, jnp.int32) if use_mono else None
     quantized = jnp.issubdtype(grad.dtype, jnp.integer)
     if quantized and qscale is None:
         raise ValueError("integer grad/hess require the qscale argument")
@@ -286,9 +308,10 @@ def grow_tree(
             tc = jax.lax.psum(tc, ax)
         return tg, th, tc
 
-    def find_best(hist, sums, depth_ok):
+    def find_best(hist, sums, depth_ok, lo=None, hi=None):
         """hist: pool entry (global for serial/data/feature, local for
-        voting); sums: GLOBAL leaf totals."""
+        voting); sums: GLOBAL leaf totals; lo/hi: the leaf's monotone
+        output bounds (None when unconstrained)."""
         sg, sh, sc = sums[0], sums[1], sums[2]
         if mode == "voting":
             # quantized: ballots are cast from the dequantized LOCAL
@@ -306,6 +329,7 @@ def grow_tree(
             lg_f, _, _, _ = best_split_per_feature(
                 lhist, local_tot[0], local_tot[1], local_tot[2],
                 meta, local_hyper, feature_mask, params.use_missing,
+                monotone=mono, leaf_lo=lo, leaf_hi=hi,
             )
             k2 = min(2 * params.top_k, f)
             _, top2k = jax.lax.top_k(lg_f, k2)
@@ -328,17 +352,21 @@ def grow_tree(
             gain_f, thr_f, dbz_f, left_f = best_split_per_feature(
                 hist_voted, sg, sh, sc, meta, hyper,
                 feature_mask * voted_mask, params.use_missing,
+                monotone=mono, leaf_lo=lo, leaf_hi=hi,
             )
-            res = finalize_split(gain_f, thr_f, dbz_f, left_f, sg, sh, sc, hyper)
+            res = finalize_split(gain_f, thr_f, dbz_f, left_f, sg, sh, sc,
+                                 hyper, leaf_lo=lo, leaf_hi=hi)
         else:
             if quantized:
                 # serial/feature: global int hist; data: already int-psum'd
                 # in _reduce_hist — either way one dequantization here
                 hist = dequantize_hist(hist, qscale)
             gain_f, thr_f, dbz_f, left_f = best_split_per_feature(
-                hist, sg, sh, sc, meta, hyper, feature_mask, params.use_missing
+                hist, sg, sh, sc, meta, hyper, feature_mask,
+                params.use_missing, monotone=mono, leaf_lo=lo, leaf_hi=hi,
             )
-            res = finalize_split(gain_f, thr_f, dbz_f, left_f, sg, sh, sc, hyper)
+            res = finalize_split(gain_f, thr_f, dbz_f, left_f, sg, sh, sc,
+                                 hyper, leaf_lo=lo, leaf_hi=hi)
             if mode == "feature":
                 # global best across feature shards: all_gather the scalar
                 # SplitInfo and take the max-gain shard (ties -> lowest
@@ -367,7 +395,13 @@ def grow_tree(
         tg, th, tc = global_sums(tg, th, tc)
         root_sums = jnp.stack([tg, th, tc])
     root_hist = hist_full(select)
-    root_res = find_best(root_hist, root_sums, jnp.array(True))
+    if use_mono:
+        root_lo = jnp.float32(NEG_INF)
+        root_hi = jnp.float32(float("inf"))
+        root_res = find_best(root_hist, root_sums, jnp.array(True),
+                             root_lo, root_hi)
+    else:
+        root_res = find_best(root_hist, root_sums, jnp.array(True))
 
     zi = jnp.zeros((L,), jnp.int32)
     zf = jnp.zeros((L,))
@@ -391,6 +425,8 @@ def grow_tree(
         rec_leaf=zri, rec_feat=zri, rec_thr=zri, rec_dbz=zri,
         rec_gain=zr, rec_lval=zr, rec_rval=zr, rec_lcnt=zr, rec_rcnt=zr,
         rec_internal_value=zr,
+        leaf_lo=jnp.full((L,), NEG_INF) if use_mono else None,
+        leaf_hi=jnp.full((L,), float("inf")) if use_mono else None,
     )
     st = _store_split(st, 0, root_res)
 
@@ -419,6 +455,19 @@ def grow_tree(
         rg, rh, rc = right[0], right[1], right[2]
         lval = leaf_output(lg, lh, hyper.lambda_l1, hyper.lambda_l2)
         rval = leaf_output(rg, rh, hyper.lambda_l1, hyper.lambda_l2)
+        if use_mono:
+            # clip the stored outputs to the parent's bounds, then
+            # tighten the children's bounds at the mid-point when the
+            # split feature is constrained (BasicLeafConstraints)
+            plo, phi = st.leaf_lo[bl], st.leaf_hi[bl]
+            lval = jnp.clip(lval, plo, phi)
+            rval = jnp.clip(rval, plo, phi)
+            cdir = mono[st.bs_feat[bl]]
+            mid = (lval + rval) * 0.5
+            child_lhi = jnp.where(cdir > 0, mid, phi)
+            child_llo = jnp.where(cdir < 0, mid, plo)
+            child_rlo = jnp.where(cdir > 0, mid, plo)
+            child_rhi = jnp.where(cdir < 0, mid, phi)
 
         # ---- partition by predicate (DataPartition::Split + the
         # DefaultValueForZero bin remap, dense_bin.hpp:191-232)
@@ -459,8 +508,20 @@ def grow_tree(
             if params.max_depth <= 0
             else child_depth < params.max_depth
         )
-        lres = find_best(left_hist, left, depth_ok)
-        rres = find_best(right_hist, right, depth_ok)
+        if use_mono:
+            lres = find_best(left_hist, left, depth_ok,
+                             child_llo, child_lhi)
+            rres = find_best(right_hist, right, depth_ok,
+                             child_rlo, child_rhi)
+            st = st._replace(
+                leaf_lo=st.leaf_lo.at[bl].set(child_llo)
+                .at[right_leaf].set(child_rlo),
+                leaf_hi=st.leaf_hi.at[bl].set(child_lhi)
+                .at[right_leaf].set(child_rhi),
+            )
+        else:
+            lres = find_best(left_hist, left, depth_ok)
+            rres = find_best(right_hist, right, depth_ok)
 
         st = st._replace(
             num_splits=s + 1,

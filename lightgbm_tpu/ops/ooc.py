@@ -121,23 +121,33 @@ def root_totals(grad, hess, select):
 
 @functools.partial(jax.jit, static_argnames=("use_missing",))
 def find_best_split(hist, sums, feature_mask, depth_ok, meta, hyper,
-                    use_missing: bool = True):
+                    use_missing: bool = True, monotone=None,
+                    leaf_lo=None, leaf_hi=None):
     """Best split over an accumulated (F, B, 3) histogram — the serial
-    branch of ``grow_tree.find_best`` verbatim."""
+    branch of ``grow_tree.find_best`` verbatim.  ``monotone`` /
+    ``leaf_lo`` / ``leaf_hi`` thread the strategy seam's constraint
+    surface (None = exact unconstrained graph); the streaming trainers
+    carry the per-leaf bounds host-side."""
     sg, sh, sc = sums[0], sums[1], sums[2]
     gain_f, thr_f, dbz_f, left_f = best_split_per_feature(
-        hist, sg, sh, sc, meta, hyper, feature_mask, use_missing
+        hist, sg, sh, sc, meta, hyper, feature_mask, use_missing,
+        monotone=monotone, leaf_lo=leaf_lo, leaf_hi=leaf_hi,
     )
-    res = finalize_split(gain_f, thr_f, dbz_f, left_f, sg, sh, sc, hyper)
+    res = finalize_split(gain_f, thr_f, dbz_f, left_f, sg, sh, sc, hyper,
+                         leaf_lo=leaf_lo, leaf_hi=leaf_hi)
     return res._replace(gain=jnp.where(depth_ok, res.gain, NEG_INF))
 
 
 @jax.jit
-def child_leaf_values(left, right, l1, l2):
+def child_leaf_values(left, right, l1, l2, leaf_lo=None, leaf_hi=None):
     """The two child outputs at the classic scalar shapes
-    (CalculateSplittedLeafOutput on (sum_g, sum_h) scalars)."""
+    (CalculateSplittedLeafOutput on (sum_g, sum_h) scalars); monotone
+    bounds clip both when given."""
     lval = leaf_output(left[0], left[1], l1, l2)
     rval = leaf_output(right[0], right[1], l1, l2)
+    if leaf_lo is not None:
+        lval = jnp.clip(lval, leaf_lo, leaf_hi)
+        rval = jnp.clip(rval, leaf_lo, leaf_hi)
     return lval, rval
 
 

@@ -106,6 +106,56 @@ class TreeArrays:
         return self
 
 
+class LinearTreeArrays(TreeArrays):
+    """TreeArrays + the linear-leaf coefficient planes of the v3 serving
+    artifact (tree/linear.py plug-in, model/ensemble.py stacking).
+
+    The raw serve path evaluates the per-leaf linear model over the hi
+    f32 plane of the gathered path features (training fitted against
+    f32 bin representatives, so f32 serve arithmetic is within the
+    documented drift contract, docs/TREES.md); rows with a NaN path
+    feature fall back to the leaf constant — LightGBM's linear-tree
+    missing semantics."""
+
+    LINEAR_FIELDS = (
+        "leaf_feat_real",  # (T, L, K) int32 — raw-path gather index
+        "leaf_feat_valid",  # (T, L, K) f32 0/1 — padded-slot mask
+        "leaf_coeff",  # (T, L, K) f32 (post-shrinkage)
+        "leaf_const",  # (T, L) f32 (post-shrinkage)
+        "leaf_is_linear",  # (T, L) bool
+    )
+    FIELDS = TreeArrays.FIELDS + LINEAR_FIELDS
+
+    def validate(self) -> "LinearTreeArrays":
+        """The node/leaf planes validate as 2-D via the base class; the
+        coefficient planes are (T, L, K) so they're checked here."""
+        three_d = ("leaf_feat_real", "leaf_feat_valid", "leaf_coeff")
+        tlk = None
+        for f in three_d:
+            a = getattr(self, f)
+            shape = tuple(getattr(a, "shape", ()))
+            if len(shape) != 3:
+                raise ValueError(
+                    f"LinearTreeArrays.{f} must be 3-D (T, L, K), "
+                    f"got shape {shape}")
+            if tlk is None:
+                tlk = shape
+            elif shape != tlk:
+                raise ValueError(
+                    f"LinearTreeArrays.{f} has shape {shape}, expected "
+                    f"{tlk} like the other coefficient planes")
+        base = TreeArrays(**{f: getattr(self, f)
+                             for f in TreeArrays.FIELDS})
+        base.validate()
+        for f in ("leaf_const", "leaf_is_linear"):
+            shape = tuple(getattr(getattr(self, f), "shape", ()))
+            if len(shape) != 2:
+                raise ValueError(
+                    f"LinearTreeArrays.{f} must be 2-D (T, L), "
+                    f"got shape {shape}")
+        return self
+
+
 def _traverse_one_tree_binned(bins, feat, thr_bin, zero_bin, dbz, is_cat, left, right):
     """(N,) leaf indices for one tree over binned data."""
     n = bins.shape[0]
@@ -210,6 +260,46 @@ def predict_raw(data_hi, data_lo, data_lo2, split_feature_real, threshold_real,
       default_value_real, default_value_real_lo, default_value_real_lo2,
       is_categorical, left_child, right_child)
     vals = jnp.take_along_axis(leaf_value, leaves, axis=1)
+    return jnp.sum(vals, axis=0)
+
+
+@jax.jit
+def predict_raw_linear(data_hi, data_lo, data_lo2, split_feature_real,
+                       threshold_real, threshold_real_lo,
+                       threshold_real_lo2, default_value_real,
+                       default_value_real_lo, default_value_real_lo2,
+                       is_categorical, left_child, right_child, leaf_value,
+                       leaf_feat_real, leaf_feat_valid, leaf_coeff,
+                       leaf_const, leaf_is_linear):
+    """(N,) raw scores with per-leaf linear models (v3 artifacts).
+
+    Traversal is identical to ``predict_raw`` (triple-float compares);
+    the leaf output is ``const + coeff . x`` over the RAW hi-plane path
+    features for linear leaves, the constant ``leaf_value`` otherwise.
+    A row with a NaN (missing) path feature degrades to the constant —
+    the linear fit never saw missing rows' imputed values, so the
+    constant is the only output the training distribution covered."""
+    leaves = jax.vmap(
+        _traverse_one_tree_raw,
+        in_axes=(None, None, None, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0),
+    )(data_hi, data_lo, data_lo2, split_feature_real,
+      threshold_real, threshold_real_lo, threshold_real_lo2,
+      default_value_real, default_value_real_lo, default_value_real_lo2,
+      is_categorical, left_child, right_child)  # (T, N)
+
+    def one_tree(lv, lval_t, lfeat, lvalid, lcoef, lconst, lisl):
+        fi = lfeat[lv]  # (N, K)
+        valid = lvalid[lv]  # (N, K)
+        x = jnp.take_along_axis(data_hi, fi, axis=1) * valid
+        bad = jnp.any(jnp.isnan(x) & (valid > 0), axis=1)
+        lin = lconst[lv] + jnp.sum(lcoef[lv] * jnp.where(
+            jnp.isnan(x), 0.0, x), axis=1)
+        use_lin = lisl[lv] & ~bad
+        return jnp.where(use_lin, lin, lval_t[lv])
+
+    vals = jax.vmap(one_tree)(leaves, leaf_value, leaf_feat_real,
+                              leaf_feat_valid, leaf_coeff, leaf_const,
+                              leaf_is_linear)  # (T, N)
     return jnp.sum(vals, axis=0)
 
 

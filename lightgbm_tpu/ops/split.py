@@ -106,6 +106,22 @@ def leaf_output(sum_g, sum_h, l1, l2):
     return -jnp.sign(sum_g) * reg / (sum_h + l2)
 
 
+def _threshold_l1(sum_g, l1):
+    """ThresholdL1 (feature_histogram.hpp:238-242), signed."""
+    return jnp.sign(sum_g) * jnp.maximum(jnp.abs(sum_g) - l1, 0.0)
+
+
+def leaf_split_gain_given_output(sum_g, sum_h, l1, l2, output):
+    """GetLeafSplitGainGivenOutput (feature_histogram.hpp): the gain a
+    leaf contributes when its output is FORCED to ``output`` (the
+    monotone-clipped value) instead of the unconstrained optimum.  At
+    the unconstrained optimum this equals ``leaf_split_gain`` exactly in
+    real arithmetic but NOT in f32 — which is why the unconstrained path
+    keeps the closed form and stays bit-identical."""
+    sg_l1 = _threshold_l1(sum_g, l1)
+    return -(2.0 * sg_l1 * output + (sum_h + l2) * output * output)
+
+
 def _argmax_prefer_high(x):
     """argmax returning the HIGHEST index among ties (right-to-left scan)."""
     n = x.shape[-1]
@@ -122,6 +138,9 @@ def best_split_per_feature(
     feature_mask: jnp.ndarray,
     use_missing: bool = True,
     has_categorical: bool = True,
+    monotone: jnp.ndarray = None,
+    leaf_lo: jnp.ndarray = None,
+    leaf_hi: jnp.ndarray = None,
 ):
     """Per-feature best split: returns (gain_f, thr_f, dbz_f, left_f) with
     shapes (F,), (F,), (F,), (F, 3).  The per-feature half of
@@ -132,13 +151,31 @@ def best_split_per_feature(
     sum_g/sum_h/num_data : leaf totals (LeafSplits snapshot) — used for the
         complement side exactly like the reference (right = total - left).
     feature_mask : (F,) f32 0/1 — feature_fraction sampling mask.
+    monotone/leaf_lo/leaf_hi : monotone-constraint surface (strategy
+        seam, docs/TREES.md).  ``monotone`` is the (F,) int32 direction
+        vector (+1/0/-1) and ``leaf_lo``/``leaf_hi`` the leaf's
+        inherited output bounds.  ``None`` (the default) compiles the
+        EXACT pre-constraint graph — the bit-parity contract for
+        unconstrained training.  When set: candidate child outputs are
+        clipped to [leaf_lo, leaf_hi], gains are scored at the clipped
+        outputs (GetLeafSplitGainGivenOutput), and candidates on a
+        constrained feature whose clipped outputs violate the direction
+        are invalidated.  Categorical candidates keep unconstrained
+        gains (their strategy direction is forced to 0; outputs are
+        still bound-clipped by the grower).
     """
     f, b, _ = hist.shape
     l1, l2 = hyper.lambda_l1, hyper.lambda_l2
     min_cnt = hyper.min_data_in_leaf
     min_hess = hyper.min_sum_hessian_in_leaf
 
-    gain_shift = leaf_split_gain(sum_g, sum_h, l1, l2)
+    if monotone is None:
+        gain_shift = leaf_split_gain(sum_g, sum_h, l1, l2)
+    else:
+        parent_out = jnp.clip(leaf_output(sum_g, sum_h, l1, l2),
+                              leaf_lo, leaf_hi)
+        gain_shift = leaf_split_gain_given_output(
+            sum_g, sum_h, l1, l2, parent_out)
     min_gain_shift = gain_shift + hyper.min_gain_to_split
 
     cum = jnp.cumsum(hist, axis=1)  # (F, B, 3)
@@ -167,7 +204,16 @@ def best_split_per_feature(
             & (rh >= min_hess)
             & (thr[None, :] <= nb[:, None] - 2)
         )
-        gain = leaf_split_gain(lg, lh, l1, l2) + leaf_split_gain(rg, rh, l1, l2)
+        if monotone is None:
+            gain = leaf_split_gain(lg, lh, l1, l2) + leaf_split_gain(rg, rh, l1, l2)
+        else:
+            lout = jnp.clip(leaf_output(lg, lh, l1, l2), leaf_lo, leaf_hi)
+            rout = jnp.clip(leaf_output(rg, rh, l1, l2), leaf_lo, leaf_hi)
+            c = monotone[:, None]  # (F, 1) broadcast over thresholds
+            bad = ((c > 0) & (lout > rout)) | ((c < 0) & (lout < rout))
+            gain = (leaf_split_gain_given_output(lg, lh, l1, l2, lout)
+                    + leaf_split_gain_given_output(rg, rh, l1, l2, rout))
+            gain = jnp.where(bad, NEG_INF, gain)
         gain = jnp.where(valid & (gain > min_gain_shift), gain, NEG_INF)
         return gain  # (F, B-1)
 
@@ -248,15 +294,23 @@ def best_split_per_feature(
 
 
 def finalize_split(gain_f, thr_f, dbz_f, left_f, sum_g, sum_h, num_data,
-                   hyper: SplitHyper) -> SplitResult:
+                   hyper: SplitHyper, leaf_lo=None, leaf_hi=None
+                   ) -> SplitResult:
     """Global argmax over the per-feature arrays (ArrayArgs::ArgMax —
-    first/lowest index wins ties) and SplitInfo assembly."""
+    first/lowest index wins ties) and SplitInfo assembly.
+    ``leaf_lo``/``leaf_hi`` (monotone bounds) clip the child outputs;
+    None keeps the exact unconstrained graph."""
     l1, l2 = hyper.lambda_l1, hyper.lambda_l2
     fbest = jnp.argmax(gain_f).astype(jnp.int32)
     gain = gain_f[fbest]
     left = left_f[fbest]
     lg, lh, lc = left[0], left[1], left[2]
     rg, rh, rc = sum_g - lg, sum_h - lh, num_data - lc
+    lout = leaf_output(lg, lh, l1, l2)
+    rout = leaf_output(rg, rh, l1, l2)
+    if leaf_lo is not None:
+        lout = jnp.clip(lout, leaf_lo, leaf_hi)
+        rout = jnp.clip(rout, leaf_lo, leaf_hi)
     return SplitResult(
         gain=gain,
         feature=fbest,
@@ -268,8 +322,8 @@ def finalize_split(gain_f, thr_f, dbz_f, left_f, sum_g, sum_h, num_data,
         right_sum_g=rg,
         right_sum_h=rh,
         right_cnt=rc,
-        left_output=leaf_output(lg, lh, l1, l2),
-        right_output=leaf_output(rg, rh, l1, l2),
+        left_output=lout,
+        right_output=rout,
     )
 
 
@@ -292,6 +346,9 @@ def best_split_feature_block(
     hyper: SplitHyper,
     feature_mask_block: jnp.ndarray,
     use_missing: bool = True,
+    monotone: jnp.ndarray = None,
+    leaf_lo: jnp.ndarray = None,
+    leaf_hi: jnp.ndarray = None,
 ) -> SplitResult:
     """Best split over a contiguous column block starting at global
     feature index ``lo``; ``hist``/``meta_block``/``feature_mask_block``
@@ -299,13 +356,16 @@ def best_split_feature_block(
     GLOBAL.  The per-feature scan is elementwise in F, so a block's
     result equals the corresponding slice of the full-matrix scan bit
     for bit — the property that lets feature-parallel ranks search only
-    their own columns yet reproduce the serial model exactly."""
+    their own columns yet reproduce the serial model exactly.
+    ``monotone`` covers only the block's columns."""
     gain_f, thr_f, dbz_f, left_f = best_split_per_feature(
         hist, sum_g, sum_h, num_data, meta_block, hyper,
-        feature_mask_block, use_missing
+        feature_mask_block, use_missing,
+        monotone=monotone, leaf_lo=leaf_lo, leaf_hi=leaf_hi,
     )
     res = finalize_split(
-        gain_f, thr_f, dbz_f, left_f, sum_g, sum_h, num_data, hyper
+        gain_f, thr_f, dbz_f, left_f, sum_g, sum_h, num_data, hyper,
+        leaf_lo=leaf_lo, leaf_hi=leaf_hi,
     )
     return res._replace(feature=res.feature + jnp.int32(lo))
 
@@ -319,11 +379,16 @@ def best_split_all_features(
     hyper: SplitHyper,
     feature_mask: jnp.ndarray,
     use_missing: bool = True,
+    monotone: jnp.ndarray = None,
+    leaf_lo: jnp.ndarray = None,
+    leaf_hi: jnp.ndarray = None,
 ) -> SplitResult:
     """Best split across every feature for one leaf (per-feature scan +
     global argmax)."""
     gain_f, thr_f, dbz_f, left_f = best_split_per_feature(
-        hist, sum_g, sum_h, num_data, meta, hyper, feature_mask, use_missing
+        hist, sum_g, sum_h, num_data, meta, hyper, feature_mask, use_missing,
+        monotone=monotone, leaf_lo=leaf_lo, leaf_hi=leaf_hi,
     )
-    return finalize_split(gain_f, thr_f, dbz_f, left_f, sum_g, sum_h, num_data, hyper)
+    return finalize_split(gain_f, thr_f, dbz_f, left_f, sum_g, sum_h,
+                          num_data, hyper, leaf_lo=leaf_lo, leaf_hi=leaf_hi)
 

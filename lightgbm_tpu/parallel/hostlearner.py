@@ -128,15 +128,19 @@ def _root_sums_q(qgrad, qhess, select):
 
 
 @functools.partial(jax.jit, static_argnames=("use_missing",))
-def _best_split(hist, lo, sg, sh, sc, meta, hyper, fmask, use_missing):
+def _best_split(hist, lo, sg, sh, sc, meta, hyper, fmask, use_missing,
+                monotone=None, leaf_lo=None, leaf_hi=None):
     return best_split_feature_block(hist, lo, sg, sh, sc, meta, hyper,
-                                    fmask, use_missing)
+                                    fmask, use_missing, monotone=monotone,
+                                    leaf_lo=leaf_lo, leaf_hi=leaf_hi)
 
 
 @functools.partial(jax.jit, static_argnames=("use_missing",))
-def _local_gains(hist, sg, sh, sc, meta, hyper, fmask, use_missing):
+def _local_gains(hist, sg, sh, sc, meta, hyper, fmask, use_missing,
+                 monotone=None, leaf_lo=None, leaf_hi=None):
     gain_f, _, _, _ = best_split_per_feature(
-        hist, sg, sh, sc, meta, hyper, fmask, use_missing
+        hist, sg, sh, sc, meta, hyper, fmask, use_missing,
+        monotone=monotone, leaf_lo=leaf_lo, leaf_hi=leaf_hi
     )
     return gain_f
 
@@ -256,17 +260,24 @@ class HostParallelLearner:
     # -- per-node best split, one exchange pattern per mode -----------
 
     def _find_best(self, jnp, hist, sums, depth_ok, meta, hyper,
-                   feature_mask, f, lo):
+                   feature_mask, f, lo, monotone=None, leaf_lo=None,
+                   leaf_hi=None):
         """Returns (gain, feat, thr, dbz, left(3,)) as numpy scalars,
-        identical on every rank."""
+        identical on every rank.  ``monotone`` covers this rank's hist
+        columns (the block slice in feature mode); the leaf bounds are
+        host scalars every rank replays identically."""
         p = self.params
+        mono_kw = ({} if monotone is None else
+                   dict(monotone=monotone, leaf_lo=jnp.float32(leaf_lo),
+                        leaf_hi=jnp.float32(leaf_hi)))
         sg, sh, sc = (np.float32(sums[0]), np.float32(sums[1]),
                       np.float32(sums[2]))
         if self.mode == "feature":
             if hist is not None:
                 res = _best_split(hist, np.int32(lo), jnp.float32(sg),
                                   jnp.float32(sh), jnp.float32(sc), meta,
-                                  hyper, feature_mask, p.use_missing)
+                                  hyper, feature_mask, p.use_missing,
+                                  **mono_kw)
                 rec = _REC.pack(float(res.gain), int(res.feature),
                                 int(res.threshold_bin),
                                 int(res.default_bin_for_zero),
@@ -287,7 +298,8 @@ class HostParallelLearner:
         else:
             if self.mode == "voting":
                 ghist, vmask = self._vote_and_merge(jnp, hist, meta, hyper,
-                                                    feature_mask, f, sc)
+                                                    feature_mask, f, sc,
+                                                    mono_kw=mono_kw)
                 fmask = feature_mask * jnp.asarray(vmask)
             elif self.quant:
                 # 2-plane int16 wire (F*B*4 bytes vs the f32 wire's
@@ -310,7 +322,7 @@ class HostParallelLearner:
             res = _best_split(jnp.asarray(ghist), np.int32(0),
                               jnp.float32(sg), jnp.float32(sh),
                               jnp.float32(sc), meta, hyper, fmask,
-                              p.use_missing)
+                              p.use_missing, **mono_kw)
             gain = float(res.gain)
             feat, thr = int(res.feature), int(res.threshold_bin)
             dbz = int(res.default_bin_for_zero)
@@ -321,10 +333,12 @@ class HostParallelLearner:
         return np.float32(gain), feat, thr, dbz, left
 
     def _vote_and_merge(self, jnp, hist, meta, hyper, feature_mask, f,
-                        node_cnt=None):
+                        node_cnt=None, mono_kw=None):
         """PV-Tree exchange: ballot -> election -> elected-column merge.
         Returns (global (F, B, 3) hist with non-elected columns zero,
-        elected 0/1 mask)."""
+        elected 0/1 mask).  ``mono_kw`` (monotone strategy) constrains
+        the local ballot gains so ranks vote for splits the constrained
+        global scan could actually take."""
         p = self.params
         nproc = self.comm.nproc
         k = max(min(p.top_k, f), 1)
@@ -344,7 +358,7 @@ class HostParallelLearner:
         )
         lg_f = np.asarray(_local_gains(hist, lt[0], lt[1], lt[2], meta,
                                        local_hyper, feature_mask,
-                                       p.use_missing))
+                                       p.use_missing, **(mono_kw or {})))
         ballot = np.argsort(-lg_f, kind="stable")[:k].astype(np.int32)
         blobs = self.comm.allgather(ballot.tobytes(), "vote")
         votes = np.zeros((f,), np.float32)
@@ -405,6 +419,24 @@ class HostParallelLearner:
         else:
             per, lo, hi = f, 0, f
             hbins, hmeta, hmask = bins, meta, feature_mask
+
+        # monotone-constraint strategy seam (tree/strategy.py): bounds
+        # replay host-side exactly as in the serial growers — every rank
+        # derives identical np.float32 bounds from the lockstep replay;
+        # unconstrained keeps the exact pre-strategy call graph (no
+        # kwargs reach the jitted kernels)
+        mono_t = p.strategy.split_gain.monotone
+        use_mono = any(c != 0 for c in mono_t)
+        if use_mono and len(mono_t) != f:
+            raise ValueError(
+                f"monotone constraint vector has {len(mono_t)} entries "
+                f"but the dataset has {f} inner features")
+        # each rank scans its own hist columns, so slice the direction
+        # vector to the block in feature mode
+        hmono = (jnp.asarray(mono_t[lo:hi], jnp.int32)
+                 if use_mono and hi > lo else None)
+        leaf_lo = np.full((L,), NEG_INF, np.float32)
+        leaf_hi = np.full((L,), np.inf, np.float32)
 
         if self.quant:
             # ---- per-tree quantization: global scales from allgathered
@@ -495,7 +527,11 @@ class HostParallelLearner:
         find = functools.partial(self._find_best, jnp, meta=hmeta,
                                  hyper=hyper, feature_mask=hmask, f=f,
                                  lo=lo)
-        store(0, find(root_hist, leaf_sum[0], True))
+        if use_mono:
+            store(0, find(root_hist, leaf_sum[0], True, monotone=hmono,
+                          leaf_lo=leaf_lo[0], leaf_hi=leaf_hi[0]))
+        else:
+            store(0, find(root_hist, leaf_sum[0], True))
 
         num_splits = 0
         l1, l2 = hyper.lambda_l1, hyper.lambda_l2
@@ -513,6 +549,19 @@ class HostParallelLearner:
                                         jnp.float32(left[1]), l1, l2))
             rval = np.float32(_leaf_out(jnp.float32(right[0]),
                                         jnp.float32(right[1]), l1, l2))
+            if use_mono:
+                # clip to the leaf's inherited bounds (exact min/max on
+                # f32 host scalars), then BasicLeafConstraints mid-point
+                # tightening for the children
+                plo, phi = leaf_lo[bl], leaf_hi[bl]
+                lval = np.float32(min(max(lval, plo), phi))
+                rval = np.float32(min(max(rval, plo), phi))
+                cdir = int(mono_t[feat])
+                mid = np.float32((lval + rval) * np.float32(0.5))
+                leaf_lo[bl] = mid if cdir < 0 else plo
+                leaf_hi[bl] = mid if cdir > 0 else phi
+                leaf_lo[right_leaf] = mid if cdir > 0 else plo
+                leaf_hi[right_leaf] = mid if cdir < 0 else phi
 
             # ---- partition (DataPartition::Split)
             if self.mode == "feature":
@@ -563,8 +612,15 @@ class HostParallelLearner:
             # ---- children best splits
             child_depth = int(leaf_depth[bl]) + 1
             depth_ok = p.max_depth <= 0 or child_depth < p.max_depth
-            lres = find(left_hist, left, depth_ok)
-            rres = find(right_hist, right, depth_ok)
+            if use_mono:
+                lres = find(left_hist, left, depth_ok, monotone=hmono,
+                            leaf_lo=leaf_lo[bl], leaf_hi=leaf_hi[bl])
+                rres = find(right_hist, right, depth_ok, monotone=hmono,
+                            leaf_lo=leaf_lo[right_leaf],
+                            leaf_hi=leaf_hi[right_leaf])
+            else:
+                lres = find(left_hist, left, depth_ok)
+                rres = find(right_hist, right, depth_ok)
 
             rec_leaf[s], rec_feat[s] = bl, feat
             rec_thr[s], rec_dbz[s] = thr, dbz

@@ -25,12 +25,14 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..ops.predict import TreeArrays
+from ..ops.predict import LinearTreeArrays, TreeArrays
 from ..utils.log import Log
 
 FORMAT_VERSION = 1  # exact flavor — byte-stable since PR 9
 QUANT_FORMAT_VERSION = 2  # quantized flavor (meta carries "flavor")
-SUPPORTED_VERSIONS = (FORMAT_VERSION, QUANT_FORMAT_VERSION)
+LINEAR_FORMAT_VERSION = 3  # linear-leaf flavor (tree/linear.py plug-in)
+SUPPORTED_VERSIONS = (FORMAT_VERSION, QUANT_FORMAT_VERSION,
+                      LINEAR_FORMAT_VERSION)
 META_KEYS = (
     "format_version",
     "num_class",
@@ -44,6 +46,8 @@ META_KEYS = (
 )
 # quantized (format_version 2) artifacts additionally require these
 QUANT_META_KEYS = ("flavor", "levels", "leaf_dtype")
+# linear (format_version 3) artifacts additionally require these
+LINEAR_META_KEYS = ("flavor",)
 
 # stack_trees() dict key -> TreeArrays field name (the stacker predates
 # TreeArrays and names the real-feature plane "split_feature")
@@ -63,11 +67,19 @@ _STACK_TO_FIELD = {
     "left_child": "left_child",
     "right_child": "right_child",
     "leaf_value": "leaf_value",
+    # linear-leaf coefficient planes (v3; leaf_feat_inner is a
+    # training-side plane the raw-serving artifact does not carry)
+    "leaf_feat_real": "leaf_feat_real",
+    "leaf_feat_valid": "leaf_feat_valid",
+    "leaf_coeff": "leaf_coeff",
+    "leaf_const": "leaf_const",
+    "leaf_is_linear": "leaf_is_linear",
 }
 
 
 def stacked_tree_arrays(models: List) -> TreeArrays:
-    """Stack host Trees into a host-side (numpy) ``TreeArrays``."""
+    """Stack host Trees into a host-side (numpy) ``TreeArrays`` —
+    ``LinearTreeArrays`` when any tree carries linear leaf models."""
     from ..model.ensemble import stack_trees
 
     stacked = stack_trees(models)
@@ -76,6 +88,8 @@ def stacked_tree_arrays(models: List) -> TreeArrays:
         for k, v in stacked.items()
         if k in _STACK_TO_FIELD
     }
+    if "leaf_coeff" in fields:
+        return LinearTreeArrays(**fields).validate()
     return TreeArrays(**fields).validate()
 
 
@@ -118,7 +132,11 @@ class PredictorArtifact:
             "feature_names": list(b.feature_names or []),
             "pandas_categorical": getattr(booster, "pandas_categorical", []) or [],
         }
-        art = cls(stacked_tree_arrays(models), meta)
+        arrays = stacked_tree_arrays(models)
+        if isinstance(arrays, LinearTreeArrays):
+            meta["format_version"] = LINEAR_FORMAT_VERSION
+            meta["flavor"] = "linear"
+        art = cls(arrays, meta)
         return art.quantize(leaf_dtype) if quantized else art
 
     @property
@@ -131,6 +149,12 @@ class PredictorArtifact:
         it unchanged."""
         if self.flavor == "quantized":
             return self
+        if self.flavor == "linear":
+            Log.fatal(
+                "Quantized serving does not support linear-leaf (v3) "
+                "artifacts — the int16 rank-quantized traversal has no "
+                "coefficient planes; serve the exact linear path, or "
+                "retrain with linear_tree=false to quantize")
         from ..ops.qpredict import quantize_tree_arrays
 
         q = quantize_tree_arrays(self.arrays, leaf_dtype=leaf_dtype,
@@ -153,6 +177,9 @@ class PredictorArtifact:
             # meta["leaf_dtype"] tells the loader how to view them back
             if self.meta.get("leaf_dtype") == "bfloat16":
                 payload["leaf_value"] = payload["leaf_value"].view(np.uint16)
+        elif self.flavor == "linear":
+            payload = {f: np.asarray(getattr(self.arrays, f))
+                       for f in LinearTreeArrays.FIELDS}
         else:
             payload = {f: getattr(self.arrays, f) for f in TreeArrays.FIELDS}
         payload["__meta__"] = np.asarray(json.dumps(self.meta))
@@ -249,6 +276,13 @@ class PredictorArtifact:
             from ..ops.qpredict import QTreeArrays, _leaf_np_dtype
 
             field_set = QTreeArrays.FIELDS
+        elif version == LINEAR_FORMAT_VERSION:
+            if meta.get("flavor") != "linear":
+                Log.fatal(
+                    "%s claims artifact format_version %d but flavor %r "
+                    "(expected 'linear') — the header is inconsistent; "
+                    "re-pack it", origin, version, meta.get("flavor"))
+            field_set = LinearTreeArrays.FIELDS
         else:
             field_set = TreeArrays.FIELDS
         missing = [f for f in field_set if f not in z]
@@ -273,6 +307,8 @@ class PredictorArtifact:
                 fields["leaf_value"] = np.asarray(
                     fields["leaf_value"]).view(_leaf_np_dtype("bfloat16"))
             arrays = QTreeArrays(levels=int(meta.get("levels", 0)), **fields)
+        elif version == LINEAR_FORMAT_VERSION:
+            arrays = LinearTreeArrays(**fields)
         else:
             arrays = TreeArrays(**fields)
         return cls(arrays, meta)
@@ -283,6 +319,8 @@ class PredictorArtifact:
         required = META_KEYS
         if self.flavor == "quantized":
             required = META_KEYS + QUANT_META_KEYS
+        elif self.flavor == "linear":
+            required = META_KEYS + LINEAR_META_KEYS
         for key in required:
             if key not in self.meta:
                 Log.fatal("Artifact metadata is missing %r", key)
@@ -325,20 +363,33 @@ class PredictorArtifact:
         a = self.arrays
         t, m = a.split_feature.shape
         L = a.leaf_value.shape[1]
-        if os.environ.get("LIGHTGBM_TPU_TREE_SHAPE_BUCKETS", "1") == "0":
-            mb, lb = m, L
-        else:
+        bucketed = os.environ.get(
+            "LIGHTGBM_TPU_TREE_SHAPE_BUCKETS", "1") != "0"
+        if bucketed:
             mb, lb = tree_shape_bucket(m), tree_shape_bucket(L)
+        else:
+            mb, lb = m, L
         if self.flavor == "quantized":
             from ..ops.qpredict import QTreeArrays
 
             fields = QTreeArrays.NODE_FIELDS
+        elif self.flavor == "linear":
+            from .compilecache import _LINEAR_TREE_ARG_FIELDS
+
+            fields = _LINEAR_TREE_ARG_FIELDS
         else:
             fields = _TREE_ARG_FIELDS
+        leaf_planes = ("leaf_value", "leaf_const", "leaf_is_linear")
         total = 0
         for f in fields:
-            itemsize = np.dtype(getattr(a, f).dtype).itemsize
-            total += t * (lb if f == "leaf_value" else mb) * itemsize
+            arr = getattr(a, f)
+            itemsize = np.dtype(arr.dtype).itemsize
+            if arr.ndim == 3:  # (T, L, K) coefficient planes
+                kb = (tree_shape_bucket(arr.shape[2]) if bucketed
+                      else arr.shape[2])
+                total += t * lb * kb * itemsize
+            else:
+                total += t * (lb if f in leaf_planes else mb) * itemsize
         return int(total)
 
     def make_objective(self):
@@ -365,12 +416,19 @@ class PackedPredictor:
     def __init__(self, artifact: PredictorArtifact,
                  quantized: Optional[bool] = None):
         from ..ops.qpredict import quant_predict_enabled
-        from .compilecache import (BucketedQuantizedPredictor,
+        from .compilecache import (BucketedLinearRawPredictor,
+                                   BucketedQuantizedPredictor,
                                    BucketedRawPredictor)
 
         want = (artifact.flavor == "quantized") if quantized is None \
             else bool(quantized)
         use_q = quant_predict_enabled(default=want)
+        if use_q and artifact.flavor == "linear":
+            Log.warning(
+                "Quantized predict was requested but the artifact is "
+                "linear-flavor (v3) — the quantized traversal has no "
+                "coefficient planes; serving the exact linear path")
+            use_q = False
         if use_q and artifact.flavor == "exact":
             artifact = artifact.quantize()
         elif not use_q and artifact.flavor == "quantized":
@@ -386,6 +444,10 @@ class PackedPredictor:
         self.objective = artifact.make_objective()
         if self.quantized:
             self.raw = BucketedQuantizedPredictor.from_qtree_arrays(
+                artifact.arrays, artifact.num_tree_per_iteration
+            )
+        elif artifact.flavor == "linear":
+            self.raw = BucketedLinearRawPredictor.from_tree_arrays(
                 artifact.arrays, artifact.num_tree_per_iteration
             )
         else:

@@ -31,7 +31,8 @@ import numpy as np
 
 from ..obs import JitWatch, tracer
 from ..obs import compilewatch
-from ..ops.predict import TreeArrays, predict_raw
+from ..ops.predict import (LinearTreeArrays, TreeArrays, predict_raw,
+                           predict_raw_linear)
 from ..utils.log import Log
 
 DEFAULT_MIN_BUCKET = 8
@@ -50,6 +51,17 @@ _TREE_ARG_FIELDS = (
     "left_child",
     "right_child",
     "leaf_value",
+)
+
+# the per-class tree-array arguments of predict_raw_linear, in call
+# order (after the three data planes): the exact fields + the v3
+# linear-leaf coefficient planes
+_LINEAR_TREE_ARG_FIELDS = _TREE_ARG_FIELDS + (
+    "leaf_feat_real",
+    "leaf_feat_valid",
+    "leaf_coeff",
+    "leaf_const",
+    "leaf_is_linear",
 )
 
 # the per-class tree-array arguments of qpredict_raw, in call order
@@ -71,6 +83,9 @@ _watched_predict_raw: Optional[JitWatch] = None
 # likewise for the quantized traversal, under "serve.qpredict"
 _watched_qpredict: Optional[JitWatch] = None
 
+# and the linear-leaf traversal, under "serve.predict_linear"
+_watched_predict_linear: Optional[JitWatch] = None
+
 
 def _watch() -> JitWatch:
     global _watched_predict_raw
@@ -78,6 +93,15 @@ def _watch() -> JitWatch:
         _watched_predict_raw = JitWatch(predict_raw, "serve.predict_raw",
                                         phase="serve_batch")
     return _watched_predict_raw
+
+
+def _lwatch() -> JitWatch:
+    global _watched_predict_linear
+    if _watched_predict_linear is None:
+        _watched_predict_linear = JitWatch(
+            predict_raw_linear, "serve.predict_linear",
+            phase="serve_batch")
+    return _watched_predict_linear
 
 
 def _qwatch() -> JitWatch:
@@ -131,6 +155,39 @@ def pad_tree_arrays(arrays: TreeArrays) -> TreeArrays:
         pad = (lb if f == "leaf_value" else mb) - a.shape[1]
         fields[f] = np.pad(a, ((0, 0), (0, pad))) if pad else a
     return TreeArrays(**fields).validate()
+
+
+def pad_linear_tree_arrays(arrays: LinearTreeArrays) -> LinearTreeArrays:
+    """Linear counterpart of ``pad_tree_arrays``: the node/leaf planes
+    pad to the same (T, bucket(M))/(T, bucket(L)) classes and the
+    coefficient planes to (T, bucket(L), bucket(K)) — K (the max leaf
+    path length) is data-dependent the same way M/L are, so it rides the
+    same ladder to keep the zero-new-compile swap contract.  Padded
+    coefficient slots are zero with ``leaf_feat_valid`` 0, so the padded
+    dot product contributes exactly 0.  Same
+    ``LIGHTGBM_TPU_TREE_SHAPE_BUCKETS=0`` opt-out."""
+    import os
+
+    if os.environ.get("LIGHTGBM_TPU_TREE_SHAPE_BUCKETS", "1") == "0":
+        return arrays
+    m = arrays.split_feature.shape[1]
+    L = arrays.leaf_value.shape[1]
+    k = arrays.leaf_coeff.shape[2]
+    mb, lb = tree_shape_bucket(m), tree_shape_bucket(L)
+    kb = tree_shape_bucket(k)
+    if mb == m and lb == L and kb == k:
+        return arrays
+    fields = {}
+    for f in LinearTreeArrays.FIELDS:
+        a = np.asarray(getattr(arrays, f))
+        if a.ndim == 3:
+            fields[f] = np.pad(
+                a, ((0, 0), (0, lb - a.shape[1]), (0, kb - a.shape[2])))
+        else:
+            pad = (lb if f in ("leaf_value", "leaf_const",
+                               "leaf_is_linear") else mb) - a.shape[1]
+            fields[f] = np.pad(a, ((0, 0), (0, pad))) if pad else a
+    return LinearTreeArrays(**fields).validate()
 
 
 def pad_qtree_arrays(arrays):
@@ -332,6 +389,49 @@ class BucketedRawPredictor:
         }
         tracer.event("serve_warmup_done", **stats)
         return stats
+
+
+class BucketedLinearRawPredictor(BucketedRawPredictor):
+    """Linear-leaf (v3 artifact) counterpart of
+    ``BucketedRawPredictor``: identical bucket-padded batching and
+    (K, N) float64 contract, traversing with
+    ``ops/predict.predict_raw_linear`` under the shared
+    "serve.predict_linear" watch.  Same-shape-class models (including
+    the coefficient K axis, ``pad_linear_tree_arrays``) share every XLA
+    program, so a hot swap to a same-shape linear retrain costs zero new
+    compiles."""
+
+    @classmethod
+    def from_tree_arrays(cls, arrays: LinearTreeArrays,
+                         num_tree_per_iteration: int,
+                         **kw) -> "BucketedLinearRawPredictor":
+        arrays.validate()
+        arrays = pad_linear_tree_arrays(arrays)
+        t = arrays.split_feature.shape[0]
+        k = int(num_tree_per_iteration)
+        if k <= 0 or t % k != 0:
+            Log.fatal("%d stacked trees are not a multiple of "
+                      "num_tree_per_iteration=%d", t, k)
+        class_arrays = []
+        for kk in range(k):
+            idx = np.arange(kk, t, k)
+            class_arrays.append(tuple(
+                np.asarray(getattr(arrays, f))[idx]
+                for f in _LINEAR_TREE_ARG_FIELDS
+            ))
+        return cls(class_arrays, **kw)
+
+    def predict_raw_scores(self, data: np.ndarray) -> np.ndarray:
+        """(K, N) float64 raw scores for (N, F) raw features."""
+        n = data.shape[0]
+        bucket = self.bucket(n)
+        planes = self._data_planes(data, bucket)
+        fn = _lwatch()
+        out = np.empty((self.num_class_arrays, n))
+        for kk, args in enumerate(self.class_arrays):
+            out[kk] = np.asarray(fn(*planes, *args), np.float64)[:n]
+        tracer.counter("serve_linear_rows", float(n))
+        return out
 
 
 class BucketedQuantizedPredictor:

@@ -1,0 +1,27 @@
+"""Composable trainer core: pluggable tree-growth strategies.
+
+``strategy.py`` defines the four seams (SplitGain / LeafFit / HistAccum
+/ StateExport) every learner consumes; ``linear.py`` is the
+piecewise-linear leaf plug-in (batched per-leaf ridge fits).  See
+docs/TREES.md.
+"""
+
+from .strategy import (
+    DEFAULT_STRATEGY,
+    HistAccumStrategy,
+    LeafFitStrategy,
+    SplitGainStrategy,
+    StateExportStrategy,
+    TreeStrategy,
+    parse_monotone_constraints,
+)
+
+__all__ = [
+    "DEFAULT_STRATEGY",
+    "HistAccumStrategy",
+    "LeafFitStrategy",
+    "SplitGainStrategy",
+    "StateExportStrategy",
+    "TreeStrategy",
+    "parse_monotone_constraints",
+]

@@ -1,0 +1,269 @@
+"""Piecewise-linear leaves — the LeafFit strategy plug-in.
+
+"Gradient Boosting With Piece-Wise Linear Regression Trees" (1802.05640,
+PAPERS.md): after a tree's structure is grown with the classic
+constant-leaf gain scan (exactly like the reference's linear_tree), each
+leaf gets a tiny ridge least-squares model over the numerical features
+on its root path.  Per boosting iteration that is L independent
+(k+1)x(k+1) normal-equation solves — batched here as ONE
+``(L, k+1, k+1)`` Cholesky/solve, which is MXU-shaped work instead of L
+scalar loops.
+
+The second-order objective restricted to leaf l is
+
+    min_w  sum_i  h_i/2 (w·x~_i)^2 + g_i (w·x~_i)  + reg(w)
+
+with x~ = (1, x_1..x_k), giving  (A + D) w = -b  where
+A = sum h_i x~ x~^T, b = sum g_i x~ (f32 accumulate, row-block
+sequential adds so results do not depend on device tiling), and D adds
+``linear_lambda`` on the slope diagonal and ``lambda_l2`` on the
+intercept (so a k=0 leaf solves to the classic constant output with
+lambda_l1=0).
+
+Drift contract (docs/TREES.md): fits and binned score updates evaluate
+features at BIN-REPRESENTATIVE values (``build_value_lut``), while raw
+serving evaluates at raw values.  Training is self-consistent — the
+same LUT feeds fit, train-score and valid-score paths — and the
+fit-vs-serve drift is bounded by bin width exactly like threshold
+quantization itself.
+
+Degenerate leaves (no numerical path features, fewer selected rows than
+coefficients, non-PD normal matrix) fall back to the grower's constant
+output; ``fit_linear_leaves`` returns a per-leaf validity mask.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# rows per accumulation block: bounds the (R, k+1, k+1) outer-product
+# intermediate while keeping the block-sequential float add order
+FIT_ROW_BLOCK = 65536
+
+
+def build_value_lut(dataset, num_bins: int) -> np.ndarray:
+    """(F, num_bins) f32 bin-representative values per INNER feature.
+
+    Numerical bins are represented by their upper bound (the same value
+    ``Tree.from_grow_result`` records as the split threshold); the last
+    bin's +inf bound is replaced by the largest finite bound so fits
+    stay finite.  Categorical columns are zeroed — they never enter a
+    linear fit (leaf_path_features drops them)."""
+    from ..io.binning import CATEGORICAL
+
+    f = dataset.num_features
+    lut = np.zeros((f, num_bins), np.float32)
+    for i in range(f):
+        m = dataset.bin_mappers[i]
+        if m.bin_type == CATEGORICAL:
+            continue
+        nb = int(m.num_bin)
+        ub = np.asarray(m.bin_upper_bound, np.float64)
+        vals = ub[:nb].copy()
+        if nb >= 2 and not np.isfinite(vals[nb - 1]):
+            vals[nb - 1] = vals[nb - 2]
+        vals = np.where(np.isfinite(vals), vals, 0.0)
+        lut[i, :nb] = vals.astype(np.float32)
+        if nb < num_bins:
+            lut[i, nb:] = lut[i, nb - 1]
+    return lut
+
+
+def leaf_path_features(gr, is_categorical) -> list:
+    """Per-leaf tuples of INNER numerical features on the leaf's root
+    path, reconstructed host-side from the GrowResult split records
+    (left child keeps the split leaf's index, right child is s+1 —
+    the same indexing model/tree.py replays)."""
+    num_splits = int(gr.num_splits)
+    rec_leaf = np.asarray(gr.rec_leaf)
+    rec_feat = np.asarray(gr.rec_feat)
+    is_cat = np.asarray(is_categorical)
+    feats = {0: ()}
+    for s in range(num_splits):
+        bl = int(rec_leaf[s])
+        f = int(rec_feat[s])
+        path = feats[bl]
+        if not is_cat[f] and f not in path:
+            path = path + (f,)
+        feats[bl] = path
+        feats[s + 1] = path
+    return [feats[i] for i in range(num_splits + 1)]
+
+
+def pack_path_features(paths, num_leaves: int, k_max: int = 0):
+    """(L, k) int32 feature-index matrix (0-padded) + (L, k) f32
+    validity mask from per-leaf path tuples.  ``k_max`` pads wider when
+    given (so OOC chunk folds reuse one compiled shape)."""
+    k = max((len(p) for p in paths), default=0)
+    k = max(k, k_max, 1)
+    idx = np.zeros((num_leaves, k), np.int32)
+    valid = np.zeros((num_leaves, k), np.float32)
+    for i, p in enumerate(paths[:num_leaves]):
+        idx[i, : len(p)] = p
+        valid[i, : len(p)] = 1.0
+    return idx, valid
+
+
+@functools.partial(jax.jit, static_argnames=("num_leaves", "row_block"))
+def linear_fit_stats(bins, grad, hess, select, leaf_id, feat_idx,
+                     feat_valid, value_lut, num_leaves: int,
+                     row_block: int = FIT_ROW_BLOCK):
+    """Accumulate the per-leaf normal equations: (L, k+1, k+1) A and
+    (L, k+1) b over the full resident matrix, in row-block order."""
+    n, f = bins.shape
+    rb = min(row_block, max(int(n), 1))
+    nblocks = -(-n // rb)
+    pad = nblocks * rb - n
+    if pad:
+        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        grad = jnp.pad(grad, (0, pad))
+        hess = jnp.pad(hess, (0, pad))
+        select = jnp.pad(select, (0, pad))
+        leaf_id = jnp.pad(leaf_id, (0, pad))
+
+    def body(i, carry):
+        a, bv = carry
+        s = i * rb
+        bb = jax.lax.dynamic_slice(bins, (s, 0), (rb, f))
+        g = jax.lax.dynamic_slice(grad, (s,), (rb,))
+        h = jax.lax.dynamic_slice(hess, (s,), (rb,))
+        sel = jax.lax.dynamic_slice(select, (s,), (rb,))
+        lid = jax.lax.dynamic_slice(leaf_id, (s,), (rb,))
+        return _fold_block(a, bv, bb, g, h, sel, lid, feat_idx,
+                           feat_valid, value_lut)
+
+    k1 = feat_idx.shape[1] + 1
+    a0 = jnp.zeros((num_leaves, k1, k1), jnp.float32)
+    b0 = jnp.zeros((num_leaves, k1), jnp.float32)
+    return jax.lax.fori_loop(0, nblocks, body, (a0, b0))
+
+
+def _fold_block(a, bv, bins_blk, g, h, sel, lid, feat_idx, feat_valid,
+                value_lut):
+    """One row block's contribution to (A, b) — shared by the resident
+    fit above and the streamed OOC fold (linear_stats_chunk)."""
+    rb = bins_blk.shape[0]
+    fi = feat_idx[lid]  # (R, k)
+    fv = feat_valid[lid]  # (R, k)
+    bcol = jnp.take_along_axis(bins_blk.astype(jnp.int32), fi, axis=1)
+    x = value_lut[fi, bcol] * fv  # (R, k), invalid slots -> 0
+    xt = jnp.concatenate([jnp.ones((rb, 1), jnp.float32), x], axis=1)
+    hw = h * sel
+    gw = g * sel
+    a = a.at[lid].add(hw[:, None, None] * xt[:, :, None] * xt[:, None, :])
+    bv = bv.at[lid].add(gw[:, None] * xt)
+    return a, bv
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def linear_stats_chunk(a, bv, bins_chunk, grad, hess, select, leaf_id,
+                       start, feat_idx, feat_valid, value_lut):
+    """Streamed counterpart of one ``linear_fit_stats`` block: fold one
+    out-of-core chunk's rows into the running (A, b) carries (the
+    ChunkFolder seam, boosting/ooc.py)."""
+    c = bins_chunk.shape[0]
+    g = jax.lax.dynamic_slice(grad, (start,), (c,))
+    h = jax.lax.dynamic_slice(hess, (start,), (c,))
+    sel = jax.lax.dynamic_slice(select, (start,), (c,))
+    lid = jax.lax.dynamic_slice(leaf_id, (start,), (c,))
+    return _fold_block(a, bv, bins_chunk, g, h, sel, lid, feat_idx,
+                       feat_valid, value_lut)
+
+
+@jax.jit
+def solve_linear_leaves(a, bv, feat_valid, leaf_cnt, linear_lambda,
+                        lambda_l2):
+    """Batched ridge solve of (A + D) w = -b per leaf via ONE Cholesky.
+
+    D = linear_lambda on valid slope slots, lambda_l2 on the intercept,
+    and 1.0 on PADDED slots (their A rows/cols are zero; the unit
+    diagonal makes the factor well-defined and solves them to exactly
+    w_j = 0).  Returns (w, ok): leaves with a non-finite factor (non-PD
+    A), no valid features, or fewer selected rows than coefficients are
+    flagged for constant fallback."""
+    l_, k1 = bv.shape
+    kv = jnp.sum(feat_valid, axis=1)  # (L,) valid slope count
+    diag = jnp.concatenate(
+        [jnp.full((l_, 1), lambda_l2, jnp.float32),
+         jnp.where(feat_valid > 0, jnp.float32(linear_lambda), 1.0)],
+        axis=1)
+    areg = a + diag[:, :, None] * jnp.eye(k1, dtype=jnp.float32)[None]
+    chol = jnp.linalg.cholesky(areg)  # NaN rows when not PD
+    y = jax.scipy.linalg.solve_triangular(chol, -bv[..., None], lower=True)
+    w = jax.scipy.linalg.solve_triangular(
+        jnp.swapaxes(chol, -1, -2), y, lower=False)[..., 0]
+    ok = (jnp.all(jnp.isfinite(w), axis=1)
+          & (kv > 0)
+          & (leaf_cnt > kv + 1.0))
+    return jnp.where(ok[:, None], w, 0.0), ok
+
+
+@jax.jit
+def linear_leaf_scores(bins, leaf_id, feat_idx, feat_valid, coeff, const,
+                       fallback, is_lin, value_lut):
+    """(N,) per-row outputs of ONE freshly-grown linear tree on binned
+    rows: the linear model where the leaf has one, the constant
+    fallback otherwise (the train-score counterpart of
+    add_leaf_outputs)."""
+    fi = feat_idx[leaf_id]
+    fv = feat_valid[leaf_id]
+    bcol = jnp.take_along_axis(bins.astype(jnp.int32), fi, axis=1)
+    x = value_lut[fi, bcol] * fv
+    lin = const[leaf_id] + jnp.sum(coeff[leaf_id] * x, axis=1)
+    return jnp.where(is_lin[leaf_id], lin, fallback[leaf_id])
+
+
+@jax.jit
+def linear_scores_chunk(bins_chunk, leaf_id, start, feat_idx, feat_valid,
+                        coeff, const, fallback, is_lin, value_lut):
+    """One chunk's (C,) outputs of a freshly-grown linear tree — the
+    streamed counterpart of ``linear_leaf_scores`` (ChunkFolder seam)."""
+    c = bins_chunk.shape[0]
+    lid = jax.lax.dynamic_slice(leaf_id, (start,), (c,))
+    fi = feat_idx[lid]
+    fv = feat_valid[lid]
+    bcol = jnp.take_along_axis(bins_chunk.astype(jnp.int32), fi, axis=1)
+    x = value_lut[fi, bcol] * fv
+    lin = const[lid] + jnp.sum(coeff[lid] * x, axis=1)
+    return jnp.where(is_lin[lid], lin, fallback[lid])
+
+
+def _leaves_one_tree(bins, feat, thr_bin, zero_bin, dbz, is_cat, left,
+                     right):
+    from ..ops.predict import _traverse_one_tree_binned
+
+    return _traverse_one_tree_binned(bins, feat, thr_bin, zero_bin, dbz,
+                                     is_cat, left, right)
+
+
+@jax.jit
+def predict_linear_binned(bins, split_feature, threshold_bin, zero_bin,
+                          default_bin_for_zero, is_categorical, left_child,
+                          right_child, leaf_value, leaf_feat,
+                          leaf_feat_valid, leaf_coeff, leaf_const,
+                          leaf_is_linear, value_lut):
+    """Sum of stacked-tree outputs on binned rows where leaves may carry
+    linear models: (T, L[, k]) planes ride alongside the classic node
+    arrays; constant trees pass leaf_is_linear all-False and reproduce
+    ``predict_binned`` values exactly (same traversal, same gather)."""
+    leaves = jax.vmap(
+        _leaves_one_tree, in_axes=(None, 0, 0, 0, 0, 0, 0, 0)
+    )(bins, split_feature, threshold_bin, zero_bin, default_bin_for_zero,
+      is_categorical, left_child, right_child)  # (T, N)
+
+    def one_tree(lv, lval_t, lf, lvalid, lc, lconst, lisl):
+        fi = lf[lv]  # (N, k)
+        fvalid = lvalid[lv]
+        bcol = jnp.take_along_axis(bins.astype(jnp.int32), fi, axis=1)
+        x = value_lut[fi, bcol] * fvalid
+        lin = lconst[lv] + jnp.sum(lc[lv] * x, axis=1)
+        return jnp.where(lisl[lv], lin, lval_t[lv])
+
+    vals = jax.vmap(one_tree)(leaves, leaf_value, leaf_feat,
+                              leaf_feat_valid, leaf_coeff, leaf_const,
+                              leaf_is_linear)
+    return jnp.sum(vals, axis=0)

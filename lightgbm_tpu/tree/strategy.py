@@ -1,0 +1,221 @@
+"""Tree-growth strategy seams — the composable trainer core.
+
+Every learner (serial grow_tree, ShardedLearner, HostParallelLearner,
+OocTrainer, DistributedOocTrainer) consumes one :class:`TreeStrategy`
+instead of re-implementing gain math, leaf fitting, histogram
+accumulation and export plumbing inline.  A strategy is a NamedTuple of
+NamedTuples so it is hashable and can ride ``GrowParams`` (a static jit
+argument): swapping a strategy recompiles the growth program, it never
+retraces per call.
+
+The four seams (docs/TREES.md):
+
+``SplitGainStrategy``
+    How candidate splits are scored and constrained.  Carries the
+    per-inner-feature monotone direction vector (+1 / 0 / -1); the
+    default (all zero) compiles to the exact pre-strategy graph.
+``LeafFitStrategy``
+    How leaf models are fitted after growth: ``const`` (the classic
+    output) or ``linear`` (per-leaf ridge least-squares over the leaf's
+    path features, tree/linear.py).
+``HistAccumStrategy``
+    How histograms accumulate: f32 or stochastically-rounded integer
+    levels with exact int32 accumulation (quantized training).
+``StateExportStrategy``
+    What leaves the trainer: leaf-model kind for checkpoints, model
+    text, and the serving-artifact format version.
+
+Extending: add a field to the relevant seam, default it to the current
+behaviour, branch where the seam is consumed, and every learner picks
+the capability up through ``GrowParams.strategy`` — one file, not five
+parallel edits.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+
+class SplitGainStrategy(NamedTuple):
+    """Split scoring: monotone direction per INNER feature (+1 increasing,
+    0 unconstrained, -1 decreasing).  Empty tuple = fully unconstrained
+    (the compiled graph is byte-identical to pre-strategy code)."""
+
+    monotone: Tuple[int, ...] = ()
+
+    @property
+    def constrained(self) -> bool:
+        return any(c != 0 for c in self.monotone)
+
+
+class LeafFitStrategy(NamedTuple):
+    """Leaf-model fit: ``const`` or ``linear`` (+ the ridge strength)."""
+
+    kind: str = "const"
+    linear_lambda: float = 0.0
+
+    @property
+    def linear(self) -> bool:
+        return self.kind == "linear"
+
+
+class HistAccumStrategy(NamedTuple):
+    """Histogram accumulation: f32, or quantized int16 gradient levels
+    with exact int32 accumulation (ops/qhist.py)."""
+
+    quantized: bool = False
+    quant_bits: int = 0  # 0 = library default (ops.qhist.QUANT_BITS)
+    quant_seed: int = 0
+
+    def resolved_bits(self) -> int:
+        if self.quant_bits:
+            return self.quant_bits
+        from ..ops.qhist import QUANT_BITS
+
+        return QUANT_BITS
+
+
+class StateExportStrategy(NamedTuple):
+    """Export surface: what the fitted leaves look like downstream.
+
+    ``leaf_model`` feeds model text / checkpoints; the serving artifact
+    picks its format version off it (v3 carries coefficient planes,
+    serve/artifact.py)."""
+
+    leaf_model: str = "const"
+
+
+class TreeStrategy(NamedTuple):
+    split_gain: SplitGainStrategy = SplitGainStrategy()
+    leaf_fit: LeafFitStrategy = LeafFitStrategy()
+    hist_accum: HistAccumStrategy = HistAccumStrategy()
+    state_export: StateExportStrategy = StateExportStrategy()
+
+    @classmethod
+    def from_config(cls, config, train_set=None) -> "TreeStrategy":
+        """Build the strategy a Config implies.  ``train_set`` (when
+        given) maps real-feature monotone constraints onto INNER feature
+        order and zeroes categorical columns (monotonicity is undefined
+        for one-vs-rest splits)."""
+        monotone: Tuple[int, ...] = ()
+        raw = getattr(config, "monotone_constraints", "") or ""
+        if str(raw).strip() and train_set is not None:
+            monotone = _inner_monotone(config, train_set)
+        leaf = LeafFitStrategy(
+            kind="linear" if getattr(config, "linear_tree", False)
+            else "const",
+            linear_lambda=float(getattr(config, "linear_lambda", 0.0)),
+        )
+        hist = HistAccumStrategy(
+            quantized=bool(getattr(config, "quantized_training", False)),
+            quant_bits=int(getattr(config, "quantized_grad_bits", 0) or 0),
+            quant_seed=int(getattr(config, "seed", 0)),
+        )
+        return cls(
+            split_gain=SplitGainStrategy(monotone=monotone),
+            leaf_fit=leaf,
+            hist_accum=hist,
+            state_export=StateExportStrategy(leaf_model=leaf.kind),
+        )
+
+
+DEFAULT_STRATEGY = TreeStrategy()
+
+
+def parse_monotone_constraints(value, num_features: int,
+                               feature_names=None) -> Tuple[int, ...]:
+    """Parse ``monotone_constraints`` into a length-``num_features``
+    tuple over REAL feature indices.
+
+    Accepted forms (LightGBM's surface):
+      * comma list: ``"+1,0,-1"`` — one entry per feature, length must
+        match ``num_features``;
+      * dict: ``{"0": 1, "f3": -1}`` — keys are feature indices or
+        names from ``feature_names``; unnamed features default to 0.
+    """
+    from ..utils.log import Log
+
+    def _dir(v, what):
+        try:
+            c = int(str(v).strip() or 0)
+        except ValueError:
+            Log.fatal(
+                "monotone_constraints: %s is not a direction "
+                "(+1 / 0 / -1)", what)
+        if c not in (-1, 0, 1):
+            Log.fatal(
+                "monotone_constraints: direction %d for %s is out of "
+                "range; use +1 (increasing), 0 (none) or -1 "
+                "(decreasing)", c, what)
+        return c
+
+    if isinstance(value, dict):
+        out = [0] * num_features
+        names = {str(n): i for i, n in enumerate(feature_names or [])}
+        for key, v in value.items():
+            k = str(key)
+            if k in names:
+                idx = names[k]
+            else:
+                try:
+                    idx = int(k)
+                except ValueError:
+                    Log.fatal(
+                        "monotone_constraints: unknown feature %r "
+                        "(not an index and not one of the dataset's "
+                        "feature names)", key)
+                if not 0 <= idx < num_features:
+                    Log.fatal(
+                        "monotone_constraints: feature index %d out of "
+                        "range for %d features", idx, num_features)
+            out[idx] = _dir(v, f"feature {key!r}")
+        return tuple(out)
+
+    parts = [p for p in str(value).split(",")]
+    if len(parts) == 1 and not parts[0].strip():
+        return tuple([0] * num_features)
+    if len(parts) != num_features:
+        Log.fatal(
+            "monotone_constraints has %d entries but the dataset has "
+            "%d features; pass one +1/0/-1 per feature (comma list) or "
+            "a {feature: direction} dict", len(parts), num_features)
+    return tuple(_dir(p, f"entry {i}") for i, p in enumerate(parts))
+
+
+def _inner_monotone(config, train_set) -> Tuple[int, ...]:
+    """Map the config's REAL-feature constraint vector onto the
+    dataset's INNER feature order, zeroing categorical columns
+    (monotonicity is undefined for one-vs-rest splits).  The EFB-bundled
+    matrix only feeds ptrainer, which declines constrained configs, so
+    inner order here is the unbundled column order."""
+    from ..io.binning import CATEGORICAL
+    from ..utils.log import Log
+
+    raw = config.monotone_constraints
+    names = getattr(train_set, "feature_names", None)
+    num_real = int(getattr(train_set, "num_total_features",
+                           train_set.num_features))
+    real = parse_monotone_constraints(raw, num_real, names)
+    if not any(real):
+        return ()
+    inner = []
+    seen_real = set()
+    for i in range(train_set.num_features):
+        r = int(train_set.inner_to_real_feature(i))
+        c = 0 if r < 0 else real[r]
+        if train_set.bin_mappers[i].bin_type == CATEGORICAL and c != 0:
+            Log.warning(
+                "monotone_constraints: feature %d is categorical; "
+                "monotonicity is undefined for one-vs-rest splits — "
+                "constraint ignored.", r)
+            c = 0
+        if r >= 0:
+            seen_real.add(r)
+        inner.append(c)
+    dropped = [r for r, c in enumerate(real) if c != 0 and r not in seen_real]
+    if dropped:
+        Log.warning(
+            "monotone_constraints: features %s were pruned or bundled "
+            "away during binning; their constraints do not apply.",
+            dropped)
+    return tuple(inner)

@@ -1,0 +1,232 @@
+"""Piecewise-linear leaf tests (tree/linear.py — the LeafFit plug-in).
+
+Covers: the batched ridge fit and its degenerate-leaf fallback, model
+text round-trips, checkpoint pack/unpack, the v3 serving artifact
+(bit-exact bucketed serving, zero-new-compile same-shape swaps, the
+quantized-serving decline), out-of-core streamed fits, the audit
+trail's leaf-model records, and the config surface's actionable fatals.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def _linear_problem(seed=0, n=2000, f=6):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = 1.0 * X[:, 0] - 0.7 * X[:, 1] + 0.3 * X[:, 2] + 0.05 * rng.randn(n)
+    return X, y
+
+
+def _train(X, y, rounds=15, **extra):
+    params = dict(objective="regression", num_leaves=15,
+                  min_data_in_leaf=20, learning_rate=0.1, verbose=-1,
+                  seed=7)
+    params.update(extra)
+    return lgb.train(params, lgb.Dataset(X, label=y),
+                     num_boost_round=rounds, verbose_eval=False)
+
+
+# ----------------------------------------------------------------------
+# fit quality + structure
+# ----------------------------------------------------------------------
+def test_linear_beats_const_on_linear_target():
+    X, y = _linear_problem()
+    Xv, yv = _linear_problem(seed=1, n=700)
+    b0 = _train(X, y)
+    b1 = _train(X, y, linear_tree=True, linear_lambda=0.01)
+    mse0 = float(np.mean((b0.predict(Xv) - yv) ** 2))
+    mse1 = float(np.mean((b1.predict(Xv) - yv) ** 2))
+    assert mse1 < mse0, (mse1, mse0)
+    # models[0] is the boost-from-average constant; every grown tree
+    # after it must carry leaf models
+    models = [t for t in b1.boosting.models[1:] if t.num_leaves > 1]
+    assert models and all(t.is_linear for t in models)
+    assert any(t.leaf_is_linear[: t.num_leaves].any() for t in models)
+
+
+def test_linear_trees_alias():
+    X, y = _linear_problem(n=600)
+    b = _train(X, y, rounds=3, linear_trees=True)
+    assert any(getattr(t, "is_linear", False) for t in b.boosting.models)
+
+
+def test_solve_degenerate_leaves_fall_back():
+    """Leaves with no valid features, too few rows, or a non-PD normal
+    matrix must be flagged for the constant fallback."""
+    from lightgbm_tpu.tree.linear import solve_linear_leaves
+
+    L, k1 = 4, 3
+    a = np.zeros((L, k1, k1), np.float32)
+    b = np.zeros((L, k1), np.float32)
+    fv = np.zeros((L, k1 - 1), np.float32)
+    # leaf 0: healthy 1-feature fit over 50 rows
+    fv[0, 0] = 1.0
+    a[0] = np.diag([50.0, 10.0, 0.0]).astype(np.float32)
+    b[0] = [5.0, -2.0, 0.0]
+    # leaf 1: no valid features; leaf 2: too few rows; leaf 3: zero A
+    fv[2, :] = 1.0
+    fv[3, 0] = 1.0
+    cnt = np.asarray([50.0, 50.0, 2.0, 50.0], np.float32)
+    w, ok = solve_linear_leaves(jnp.asarray(a), jnp.asarray(b),
+                                jnp.asarray(fv), jnp.asarray(cnt),
+                                jnp.float32(0.0), jnp.float32(0.0))
+    ok = np.asarray(ok)
+    w = np.asarray(w)
+    assert ok[0] and not ok[1] and not ok[2]
+    np.testing.assert_allclose(w[0, :2], [-0.1, 0.2], atol=1e-6)
+    np.testing.assert_array_equal(w[1], 0.0)
+    np.testing.assert_array_equal(w[2], 0.0)
+
+
+# ----------------------------------------------------------------------
+# round trips
+# ----------------------------------------------------------------------
+def test_text_roundtrip_exact():
+    X, y = _linear_problem(n=900)
+    b = _train(X, y, rounds=6, linear_tree=True)
+    s = b.model_to_string()
+    assert "is_linear=1" in s
+    b2 = lgb.Booster(model_str=s)
+    Xq = np.random.RandomState(3).randn(200, X.shape[1])
+    np.testing.assert_array_equal(b.predict(Xq), b2.predict(Xq))
+
+
+def test_checkpoint_pack_roundtrip():
+    from lightgbm_tpu.ckpt.state import pack_trees, unpack_trees
+
+    X, y = _linear_problem(n=900)
+    b = _train(X, y, rounds=5, linear_tree=True)
+    models = b.boosting.models
+    back = unpack_trees(pack_trees(models))
+    Xq = np.asarray(np.random.RandomState(4).randn(150, X.shape[1]),
+                    np.float64)
+    p0 = sum(t.predict(Xq) for t in models)
+    p1 = sum(t.predict(Xq) for t in back)
+    np.testing.assert_array_equal(np.asarray(p0), np.asarray(p1))
+
+
+def test_constant_checkpoint_keys_unchanged():
+    """Constant-tree checkpoints must not grow linear keys (container
+    bit-compat with pre-strategy checkpoints)."""
+    from lightgbm_tpu.ckpt.state import pack_trees
+
+    X, y = _linear_problem(n=600)
+    b = _train(X, y, rounds=3)
+    keys = set(pack_trees(b.boosting.models))
+    assert not any(k.startswith("tree_leaf_feat") or k == "tree_is_linear"
+                   for k in keys)
+
+
+# ----------------------------------------------------------------------
+# v3 serving artifact
+# ----------------------------------------------------------------------
+def test_v3_artifact_serves_bit_exact_and_swaps_free(tmp_path):
+    from lightgbm_tpu.obs import compilewatch
+    from lightgbm_tpu.serve.artifact import (PackedPredictor,
+                                             PredictorArtifact)
+
+    X, y = _linear_problem(n=1200)
+    b = _train(X, y, rounds=8, linear_tree=True)
+    art = PredictorArtifact.from_booster(b)
+    assert art.meta["format_version"] == 3
+    assert art.flavor == "linear"
+    p = str(tmp_path / "m.npz")
+    art.save(p)
+    pp = PackedPredictor(PredictorArtifact.load(p))
+    Xq = np.asarray(np.random.RandomState(5).randn(257, X.shape[1]),
+                    np.float64)
+    got = pp.raw.predict_raw_scores(Xq)
+    want = b.predict(Xq, raw_score=True)
+    np.testing.assert_allclose(got[0], want, atol=1e-6)
+    # same-shape retrain swap: zero new compiles through the bucket cache
+    b2 = _train(X, y, rounds=8, linear_tree=True, seed=11)
+    art2 = PredictorArtifact.from_booster(b2)
+    c0 = compilewatch.total_compiles()
+    pp2 = PackedPredictor(art2)
+    pp2.raw.predict_raw_scores(Xq)
+    assert compilewatch.total_compiles() == c0
+
+
+def test_v3_artifact_declines_quantization():
+    from lightgbm_tpu.serve.artifact import PredictorArtifact
+
+    X, y = _linear_problem(n=800)
+    b = _train(X, y, rounds=4, linear_tree=True)
+    art = PredictorArtifact.from_booster(b)
+    with pytest.raises(LightGBMError, match="linear"):
+        art.quantize()
+
+
+def test_constant_artifact_stays_v1():
+    from lightgbm_tpu.serve.artifact import PredictorArtifact
+
+    X, y = _linear_problem(n=600)
+    b = _train(X, y, rounds=3)
+    art = PredictorArtifact.from_booster(b)
+    assert art.meta["format_version"] == 1
+    assert not hasattr(art.arrays, "leaf_coeff")
+
+
+# ----------------------------------------------------------------------
+# out-of-core streamed fit
+# ----------------------------------------------------------------------
+def test_ooc_linear_training_close_to_resident():
+    """Streamed (A, b) folds run over the chunk grid instead of the
+    resident row blocks — the f32 add order differs (documented drift,
+    docs/TREES.md), so the check is closeness, not bit-parity."""
+    X, y = _linear_problem(n=1600)
+    b0 = _train(X, y, rounds=6, linear_tree=True)
+    b1 = _train(X, y, rounds=6, linear_tree=True, out_of_core="true",
+                ooc_chunk_rows=512)
+    Xq = np.random.RandomState(6).randn(300, X.shape[1])
+    np.testing.assert_allclose(b0.predict(Xq), b1.predict(Xq), atol=1e-4)
+
+
+# ----------------------------------------------------------------------
+# audit trail
+# ----------------------------------------------------------------------
+def test_audit_records_leaf_models(tmp_path):
+    from lightgbm_tpu.obs.audit import audit
+
+    X, y = _linear_problem(n=900)
+    path = str(tmp_path / "trail.jsonl")
+    os.environ["LIGHTGBM_TPU_AUDIT"] = path
+    try:
+        _train(X, y, rounds=3, linear_tree=True)
+    finally:
+        audit.close()
+        audit.path = None
+        os.environ.pop("LIGHTGBM_TPU_AUDIT", None)
+    trees = [json.loads(line) for line in open(path)
+             if json.loads(line).get("ev") == "tree"]
+    assert trees
+    lin = [t for t in trees if t.get("leaf_model") == "linear"]
+    assert lin, "no linear leaf-model records in the audit trail"
+    rec = lin[0]
+    assert len(rec["coeff"]) == rec["leaves"]
+    assert len(rec["const"]) == rec["leaves"]
+    assert any(rec["linear_leaves"])
+
+
+# ----------------------------------------------------------------------
+# config surface
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("bad", [
+    {"linear_tree": True, "quantized_training": True},
+    {"linear_tree": True, "boosting": "dart"},
+    {"linear_lambda": -1.0},
+])
+def test_config_fatals(bad):
+    X, y = _linear_problem(n=400)
+    params = dict(objective="regression", num_leaves=7, verbose=-1, **bad)
+    with pytest.raises(LightGBMError):
+        lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=1,
+                  verbose_eval=False)

@@ -1,0 +1,143 @@
+"""Monotone-constraint plug-in tests (tree/strategy.py SplitGain seam).
+
+The property under test is LightGBM's "basic" monotone mode: with
+``monotone_constraints`` +1/-1 on a feature, sweeping that feature over
+its whole bin grid (all other features held fixed) must never move the
+prediction in the forbidden direction — on the serial learner AND on the
+host-driven 2-rank learner (LocalComm).  All-zero constraints must stay
+bit-identical to unconstrained training (the strategy seam compiles the
+exact pre-strategy graph when inactive).
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.model.tree import Tree
+from lightgbm_tpu.objective import create_objective
+from lightgbm_tpu.ops.grow import GrowParams
+from lightgbm_tpu.ops.split import FeatureMeta, SplitHyper
+from lightgbm_tpu.tree.strategy import TreeStrategy
+
+
+def _problem(seed=0, n=2500, f=6):
+    rng = np.random.RandomState(seed)
+    X = rng.uniform(-2.0, 2.0, size=(n, f))
+    y = (1.2 * X[:, 0] - 0.8 * X[:, 1] + 0.4 * np.sin(3 * X[:, 2])
+         + 0.2 * rng.randn(n))
+    return X, y
+
+
+def _assert_monotone(predict, f, feat, sign, rng, grid_n=48, rows=40,
+                     tol=1e-6):
+    """Sweep ``feat`` over its range for random base rows; the signed
+    finite differences must all be >= -tol."""
+    base = rng.uniform(-2.0, 2.0, size=(rows, f))
+    grid = np.linspace(-2.2, 2.2, grid_n)
+    preds = np.stack([predict(_with(base, feat, v)) for v in grid])
+    worst = float((np.diff(preds, axis=0) * sign).min())
+    assert worst >= -tol, (
+        f"monotone constraint {sign:+d} violated on feature {feat}: "
+        f"worst signed delta {worst}")
+
+
+def _with(base, feat, v):
+    Z = base.copy()
+    Z[:, feat] = v
+    return Z
+
+
+@pytest.mark.parametrize("learner", ["serial", "data"])
+def test_monotone_sweep_booster(learner):
+    X, y = _problem()
+    params = {"objective": "regression", "num_leaves": 31,
+              "min_data_in_leaf": 20, "learning_rate": 0.1, "verbose": -1,
+              "seed": 3, "monotone_constraints": "1,-1,0,0,0,0",
+              "tree_learner": learner}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=25,
+                    verbose_eval=False)
+    rng = np.random.RandomState(7)
+    _assert_monotone(bst.predict, X.shape[1], 0, +1, rng)
+    _assert_monotone(bst.predict, X.shape[1], 1, -1, rng)
+
+
+def test_monotone_sweep_hostlearner_2rank():
+    """One tree grown by the 2-rank host-driven data-parallel learner
+    (LocalComm) must satisfy the constraints: every rank replays the
+    mid-point bound tables host-side, no extra exchange."""
+    from lightgbm_tpu.parallel import HostParallelLearner, LocalGroup
+
+    X, y = _problem(seed=4, n=3000)
+    f = X.shape[1]
+    cfg = Config.from_params(
+        {"objective": "regression", "num_leaves": 15,
+         "min_data_in_leaf": 20, "verbose": -1,
+         "monotone_constraints": "1,-1,0,0,0,0"})
+    ds = BinnedDataset.from_raw(X, cfg, label=y)
+    obj = create_objective(cfg)
+    obj.init(ds.metadata, ds.num_data)
+    grad, hess = obj.get_gradients(jnp.zeros((ds.num_data,), jnp.float32))
+    grad_np = np.asarray(grad)
+    hess_np = np.asarray(hess)
+    strategy = TreeStrategy.from_config(cfg, ds)
+    assert strategy.split_gain.constrained
+    params = GrowParams(num_leaves=15, num_bins=ds.max_num_bin,
+                        strategy=strategy)
+    meta = FeatureMeta.from_dataset(ds)
+    hyper = SplitHyper.from_config(cfg)
+    fmask = jnp.ones((f,), jnp.float32)
+    bins = np.asarray(ds.binned)
+    rows = np.array_split(np.arange(ds.num_data), 2)
+    grp = LocalGroup(2)
+    out = [None] * 2
+    errs = []
+
+    def worker(r, comm):
+        try:
+            idx = rows[r]
+            learner = HostParallelLearner("data", comm, params)
+            gr = learner.grow(
+                jnp.asarray(bins[idx]), jnp.asarray(grad_np[idx]),
+                jnp.asarray(hess_np[idx]),
+                jnp.ones((len(idx),), jnp.float32), fmask, meta, hyper)
+            out[r] = jax.tree_util.tree_map(np.asarray, gr)
+        except BaseException as e:  # surfaced below
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=worker, args=(r, c))
+          for r, c in enumerate(grp.comms())]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errs:
+        raise errs[0][1]
+    assert int(out[0].num_splits) > 0
+    tree = Tree.from_grow_result(out[0], ds)
+    rng = np.random.RandomState(11)
+
+    def predict(Z):
+        return tree.predict(np.asarray(Z, np.float64))
+
+    _assert_monotone(predict, f, 0, +1, rng, rows=25)
+    _assert_monotone(predict, f, 1, -1, rng, rows=25)
+
+
+def test_all_zero_constraints_bit_identical():
+    """monotone_constraints of all zeros must keep training on the
+    pre-strategy graph: model bytes identical to no constraints at all."""
+    X, y = _problem(seed=9, n=1200)
+    base = {"objective": "regression", "num_leaves": 15,
+            "min_data_in_leaf": 20, "verbose": -1, "seed": 5}
+    b0 = lgb.train(dict(base), lgb.Dataset(X, label=y), num_boost_round=8,
+                   verbose_eval=False)
+    b1 = lgb.train(dict(base, monotone_constraints="0,0,0,0,0,0"),
+                   lgb.Dataset(X, label=y), num_boost_round=8,
+                   verbose_eval=False)
+    assert b0.model_to_string() == b1.model_to_string()

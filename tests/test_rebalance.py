@@ -167,12 +167,18 @@ def _free_port():
 @pytest.mark.faultinject
 @pytest.mark.netfault
 def test_rebalance_moves_rows_off_injected_straggler(tmp_path):
-    """Rank 0 of 2 sleeps 10 ms at every hardened collective from the
+    """Rank 0 of 2 sleeps 40 ms at every hardened collective from the
     5th on (the new ``delay:ms:after:N`` form, scaled by the rank's
     row-count ratio).  The controller must detect the persistent
     straggler, shift rows to rank 1 at an iteration boundary, finish
     training with both ranks bit-identical, and leave ``rebalance.plan``
-    trace events that ``report merge`` summarizes."""
+    trace events that ``report merge`` summarizes.
+
+    The delay is 40 ms (not the historical 10 ms) so the injected
+    straggle dominates scheduler noise on a loaded CI machine — at
+    10 ms, OS jitter occasionally swamped the EWMA signal and the
+    controller (correctly) never fired, flaking the assertion that
+    rows moved."""
     out = str(tmp_path / "rb")
     port = _free_port()
     env = {k: v for k, v in os.environ.items()
@@ -181,7 +187,7 @@ def test_rebalance_moves_rows_off_injected_straggler(tmp_path):
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env.update(ELASTIC_ROWS="512", ELASTIC_TREES="12", ELASTIC_FREQ="6",
                ELASTIC_REBALANCE="1",
-               LIGHTGBM_TPU_FAULT="delay:10:after:5",
+               LIGHTGBM_TPU_FAULT="delay:40:after:5",
                LIGHTGBM_TPU_FAULT_RANK="0")
     procs = []
     for r in range(2):
