@@ -142,6 +142,26 @@ def apply_regression_gate(out: dict, bench_dir: str = None, env=None) -> int:
         if float(ratio_l) > 0.8:
             out["regression_linear_tree"] = True
             rc = 1
+    # spot-economics leg, same regime: cost is member-seconds x price
+    # arithmetic and the zero-lost-iterations record is write-once KV
+    # bookkeeping — both device-independent, so the <=0.8x spot-vs-
+    # static cost contract AND the nothing-redone proof gate outright
+    # even on backend_fallback captures (docs/FACTORY.md)
+    sp = out.get("spot") or {}
+    if sp and not sp.get("error"):
+        ratio_s = sp.get("cost_ratio_spot_vs_static")
+        out["gate_spot"] = {
+            "max_cost_ratio_spot_vs_static": 0.8,
+            "cost_ratio_spot_vs_static": ratio_s,
+            "require_zero_lost_iterations": True,
+            "zero_lost_iterations": sp.get("zero_lost_iterations"),
+        }
+        if not sp.get("zero_lost_iterations"):
+            out["regression_spot_lost_iterations"] = True
+            rc = 1
+        if isinstance(ratio_s, (int, float)) and float(ratio_s) > 0.8:
+            out["regression_spot_cost"] = True
+            rc = 1
     if out.get("backend_fallback"):
         return rc
     best, src = best_prior_sec_per_iter(bench_dir, out.get("metric"))
@@ -1494,6 +1514,86 @@ def _bench_elastic():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _bench_spot():
+    """Spot-economics A/B (docs/FACTORY.md "spot"): one elastic
+    2-member fleet (tests/membership_worker.py over the file-KV
+    membership runtime, factory/spot.py driver) run through a scripted
+    2-preemption capacity trace at the spot price, vs the same fleet
+    left static at the on-demand price.  Reports cost-per-completed-
+    model on both ledgers, their ratio, resize-pause p50/p99 from the
+    survivors, and the zero-lost-iterations proof from the write-once
+    per-iteration KV records.  Cost is member-seconds x price
+    arithmetic — device-independent — so the <=0.8x ratio and the
+    nothing-redone contract gate outright even on backend_fallback
+    captures (apply_regression_gate).  BENCH_SPOT=0 skips;
+    BENCH_SPOT_ROWS / BENCH_SPOT_TREES / BENCH_SPOT_PRICE resize."""
+    import tempfile
+
+    from lightgbm_tpu.factory.spot import (ON_DEMAND_PRICE, SpotFleet,
+                                           SpotSchedule,
+                                           run_static_baseline)
+
+    rows = int(os.environ.get("BENCH_SPOT_ROWS", 600))
+    trees = int(os.environ.get("BENCH_SPOT_TREES", 16))
+    price = float(os.environ.get("BENCH_SPOT_PRICE", "0.3"))
+    # pacing keeps the scripted event times inside the run on a fast
+    # box; it inflates spot and static member-seconds identically, so
+    # the cost ratio is pacing-invariant
+    pace = {"MEMBER_ITER_SLEEP": os.environ.get("BENCH_SPOT_PACE", "0.8")}
+    # preempt member 1 early (the fleet resizes to one survivor), spawn
+    # replacement capacity right after (it auto-resumes from the
+    # coordinator handoff), then preempt member 0 late — the replacement
+    # finishes the model alone
+    script = "preempt@5=1;spawn@6;preempt@20=0"
+    try:
+        with tempfile.TemporaryDirectory(prefix="bench_spot_") as tmp:
+            static = run_static_baseline(
+                os.path.join(tmp, "static"), 2,
+                os.path.join(tmp, "static_ledger.json"),
+                trees=trees, rows=rows, extra_env=dict(pace))
+            if static["cost"] is None:
+                raise RuntimeError(
+                    f"static fleet incomplete: exits={static['exits']}")
+            fleet = SpotFleet(
+                os.path.join(tmp, "spot"),
+                SpotSchedule.from_script(script, price), 2,
+                os.path.join(tmp, "spot_ledger.json"),
+                trees=trees, rows=rows, extra_env=dict(pace))
+            spot = fleet.run()
+            if spot["cost"] is None:
+                raise RuntimeError(
+                    f"spot fleet incomplete: exits={spot['exits']}")
+            pauses = sorted(
+                p for meta in spot["metas"].values()
+                for p in meta.get("resize_pauses") or [])
+
+        def pct(q):
+            if not pauses:
+                return None
+            return round(pauses[min(len(pauses) - 1,
+                                    int(q * len(pauses)))], 4)
+
+        return {
+            "rows": rows, "trees": trees, "members": 2,
+            "schedule": script,
+            "spot_price": price, "on_demand_price": ON_DEMAND_PRICE,
+            "static_cost_per_model": round(static["cost"], 3),
+            "spot_cost_per_model": round(spot["cost"], 3),
+            "cost_ratio_spot_vs_static": round(
+                spot["cost"] / static["cost"], 3),
+            "preemptions": sum(1 for e in fleet.schedule.events
+                               if e.kind == "preempt"),
+            "resize_pauses": len(pauses),
+            "resize_pause_p50_s": pct(0.50),
+            "resize_pause_p99_s": pct(0.99),
+            "zero_lost_iterations": bool(spot["zero_lost_iterations"]),
+            "static_wall_s": static["wall_s"],
+            "spot_wall_s": spot["wall_s"],
+        }
+    except Exception as e:  # pragma: no cover
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _bench_ooc_distributed():
     """Distributed out-of-core section (docs/DATA.md "Distributed
     streaming", docs/PARALLEL.md): two REAL 2-rank subprocess fleets
@@ -2023,6 +2123,15 @@ def main():
     # device-independent leg of the regression gate.
     if os.environ.get("BENCH_ELASTIC", "1") != "0":
         out["elastic"] = _bench_elastic()
+
+    # spot-economics section (docs/FACTORY.md): elastic 2-member fleet
+    # under a scripted 2-preemption trace vs the static on-demand
+    # reference — cost-per-model ratio, resize-pause p50/p99, and the
+    # zero-lost-iterations proof.  Runs even on backend_fallback: the
+    # cost ratio is price arithmetic, the device-independent leg of the
+    # regression gate.
+    if os.environ.get("BENCH_SPOT", "1") != "0":
+        out["spot"] = _bench_spot()
 
     # distributed out-of-core section (docs/DATA.md): 2-rank streaming
     # fleets at two chunk grids + the quantized byte-parity contract.

@@ -75,6 +75,8 @@ class GBDT:
         self.max_feature_idx = 0
         self.label_idx = 0
         self._rebalance = None
+        self._membership = None
+        self._iter_complete = False
 
     # ------------------------------------------------------------------
     def init(self, config, train_set, objective, training_metrics=()):
@@ -107,7 +109,50 @@ class GBDT:
 
             ensure_initialized(config)
 
+        # live elastic membership (parallel/membership.py): armed only
+        # when the knob is on AND a MembershipRuntime has adopted an
+        # epoch (bootstrap()/join() ran before Booster construction).
+        # OFF is the exact static-fleet path — zero extra collectives.
+        self._membership = None
+        self._membership_pauses = []  # resize stalls (spot bench p50/p99)
+        if getattr(config, "elastic_membership", False):
+            from ..parallel import membership as _mship
+
+            rt = _mship.runtime()
+            if rt is None:
+                rt = _mship.runtime_from_env()
+            if rt is None or rt.epoch < 0:
+                Log.warning(
+                    "elastic_membership=true ignored: no adopted "
+                    "MembershipRuntime (call bootstrap()/join(), or set "
+                    "LIGHTGBM_TPU_MEMBER_DIR, before training)")
+            else:
+                self._membership = rt
+
         if objective is not None:
+            md = train_set.metadata
+            if (md.query_boundaries is not None
+                    and config.tree_learner.lower() in
+                    ("data", "feature", "voting")):
+                # world-invariant ranking program: pad every shard's
+                # queries to the GLOBAL max group size — a dataset
+                # constant under whole-group moves.  Padding to the
+                # local max would tie the (Q, S, S) lambda-matrix shape
+                # (and so the f32 reduction order) to the world size
+                # and to every reshard; quantized stochastic rounding
+                # then amplifies the ulp drift into different trees.
+                import jax as _jax
+
+                _gs = np.diff(np.asarray(md.query_boundaries, np.int64))
+                local_s = int(_gs.max()) if len(_gs) else 1
+                if _jax.process_count() > 1:
+                    from ..parallel import collect as _collect
+
+                    blobs = _collect.allgather_bytes(
+                        local_s.to_bytes(8, "little"), "misc")
+                    local_s = max(int.from_bytes(b, "little")
+                                  for b in blobs)
+                md.pad_group_size = local_s
             objective.init(train_set.metadata, self.num_data)
 
         # persistent compile cache, keyed on the now-known backend
@@ -123,6 +168,11 @@ class GBDT:
 
         self.ooc = None
         ooc_on, ooc_chunk_rows, ooc_why = resolve_out_of_core(config, train_set)
+        if ooc_on and self._membership is not None:
+            Log.fatal(
+                "elastic_membership is not supported with out-of-core "
+                "streaming: membership transitions reshard rows in RAM, "
+                "but streamed rows are disk-resident")
         if ooc_on:
             forced = "forced" in ooc_why
             unsupported = None
@@ -149,7 +199,12 @@ class GBDT:
             from ..ops import qhist as _qhist
 
             n_rows = self.num_data
-            if config.tree_learner.lower() in ("data", "feature", "voting"):
+            if self._membership is not None:
+                # the membership runtime already carries the fleet's
+                # global row count; joiners must NOT issue init-time
+                # collectives (the survivors are mid-iteration)
+                n_rows = int(self._membership.num_data)
+            elif config.tree_learner.lower() in ("data", "feature", "voting"):
                 import jax as _jax
 
                 if _jax.process_count() > 1:
@@ -207,7 +262,29 @@ class GBDT:
         learner_type = config.tree_learner.lower()
         self.learner = None
         self.ptrainer = None
-        if ooc_on:
+        if self._membership is not None:
+            # elastic fleet: every member runs single-process JAX (the
+            # jax.distributed service pins the world at init and turns
+            # any peer death into an uncatchable C++ fatal), so the
+            # leaf-wise loop is host-driven over the shared KV store.
+            # The comm's rank/world are live properties of the epoch —
+            # a transition resizes the learner with no learner change.
+            from ..parallel.hostlearner import HostParallelLearner
+            from ..parallel.membership import MembershipComm
+
+            if train_set.metadata.query_boundaries is not None:
+                Log.fatal(
+                    "elastic_membership does not support query-grouped "
+                    "(ranking) datasets yet: transitions cannot "
+                    "re-derive group boundaries across the new world")
+            self.learner = HostParallelLearner(
+                "data", MembershipComm(self._membership), self.grow_params)
+            Log.info(
+                "Using host-driven elastic data-parallel learner: "
+                "member=%d rank=%d/%d epoch=%d", self._membership.id,
+                self._membership.rank, self._membership.nproc,
+                self._membership.epoch)
+        elif ooc_on:
             import jax as _jax
 
             if learner_type == "data" and _jax.process_count() > 1:
@@ -356,6 +433,12 @@ class GBDT:
         if getattr(config, "rebalance", False):
             self._init_rebalance()
 
+        # elastic joiner: adopt the fleet's canonical state (the handoff
+        # the coordinator published at admission).  No collectives here —
+        # the survivors are mid-iteration when a joiner initializes.
+        if self._membership is not None and self._membership.joined_mid_run:
+            self._membership_join_restore()
+
     def add_valid(self, valid_set, valid_metrics, name: str):
         """GBDT::AddValidDataset (gbdt.cpp:220-250)."""
         self.valid_sets.append(valid_set)
@@ -397,7 +480,18 @@ class GBDT:
             label = np.asarray(self.train_set.metadata.label)
             import jax as _jax
 
-            if _jax.process_count() > 1:
+            if self._membership is not None:
+                # global label average over the live fleet (same
+                # Allreduce shape as below, on the membership transport)
+                sums = np.stack([
+                    np.frombuffer(b, np.float64)
+                    for b in self._membership.comm_allgather(
+                        np.asarray([label.sum(), float(len(label))],
+                                   np.float64).tobytes(),
+                        what="label_average")
+                ])
+                init_score = float(sums[:, 0].sum() / max(sums[:, 1].sum(), 1.0))
+            elif _jax.process_count() > 1:
                 # distributed label average (GBDT::LabelAverage Allreduce,
                 # gbdt.cpp:349-379): every process must boost from the
                 # GLOBAL mean, not its local shard's
@@ -459,7 +553,35 @@ class GBDT:
     # ------------------------------------------------------------------
     def train_one_iter(self, gradients=None, hessians=None, is_eval: bool = True) -> bool:
         """One boosting iteration (GBDT::TrainOneIter, gbdt.cpp:381-495).
-        Returns True when training should stop."""
+        Returns True when training should stop.
+
+        Under elastic membership this is a bounded retry loop: a peer
+        death surfaces as ``net.PeerFailureError`` from some collective,
+        the survivors negotiate a fleet resize at this boundary, and the
+        iteration is replayed (or, when it already completed and only
+        the boundary bookkeeping was cut short, skipped)."""
+        if self._membership is None:
+            return self._train_one_iter_impl(gradients, hessians, is_eval)
+
+        from ..parallel import net as _net
+
+        for _attempt in range(3):
+            self._iter_complete = False
+            try:
+                return self._train_one_iter_impl(gradients, hessians, is_eval)
+            except _net.PeerFailureError as e:
+                self._membership_recover(e)
+                if self._iter_complete:
+                    # the trees of this iteration landed before the
+                    # failure; only sync/eval was cut short — do not
+                    # train it twice
+                    return False
+        raise _net.PeerFailureError(
+            "membership recovery did not converge after 3 attempts")
+
+    def _train_one_iter_impl(self, gradients=None, hessians=None,
+                             is_eval: bool = True) -> bool:
+        """The actual iteration body (see :meth:`train_one_iter`)."""
         from ..utils.profiling import timetag
 
         if self.ptrainer is not None and gradients is None:
@@ -468,6 +590,13 @@ class GBDT:
         import time as _time
 
         t_iter0 = _time.perf_counter()
+        if self._membership is not None:
+            # boundary snapshot for exact replay: a mid-iteration peer
+            # failure rolls the RNG streams (and the bagging mask) back
+            # so the retried iteration draws identical samples
+            self._member_iter_snapshot = (
+                self.bag_rng.get_state(), self.feature_rng.get_state(),
+                self.select)
         self._boost_from_average()
 
         # comms-volume accounting: the host-driven parallel learners keep
@@ -588,6 +717,7 @@ class GBDT:
             return True
 
         self.iter += 1
+        self._iter_complete = True
         if self.ptrainer is not None:
             # scores advanced outside the partitioned channel
             self.ptrainer.score_dirty = True
@@ -595,6 +725,9 @@ class GBDT:
             # lockstep on every rank: the tree growing above is
             # collective, so all ranks reach this boundary together
             self._maybe_rebalance(_time.perf_counter() - t_iter0)
+        if self._membership is not None:
+            # membership churn drains to this same lockstep boundary
+            self._maybe_membership()
         if is_eval:
             return self.eval_and_check_early_stopping()
         return False
@@ -990,6 +1123,16 @@ class GBDT:
     # ------------------------------------------------------------------
     # straggler-aware shard rebalancing (parallel/shardplan.py)
     # ------------------------------------------------------------------
+    def _rebalance_gather(self, blob: bytes):
+        """The rebalance control-plane allgather: membership fleets ride
+        the epoch-aware learner comm (jax.process_count() is 1 there);
+        static fleets keep the exact pre-existing byte collectives."""
+        if self._membership is not None:
+            return self.learner.comm.allgather(blob, purpose="rebalance")
+        from ..parallel.collect import allgather_bytes
+
+        return allgather_bytes(blob, purpose="rebalance")
+
     def _init_rebalance(self) -> None:
         """Arm the rebalance controller when this run actually owns a
         row shard; otherwise log why the knob is ignored."""
@@ -997,10 +1140,11 @@ class GBDT:
 
         from ..parallel.hostlearner import HostParallelLearner
 
-        nproc = _jax.process_count()
+        rt = self._membership
+        nproc = rt.nproc if rt is not None else _jax.process_count()
         md = self.train_set.metadata
         why = None
-        if nproc <= 1:
+        if nproc <= 1 and rt is None:
             why = "single process (nothing to rebalance)"
         elif self.ptrainer is not None:
             why = "fused partitioned trainer (static device layout)"
@@ -1011,37 +1155,52 @@ class GBDT:
         elif (isinstance(self.learner, HostParallelLearner)
               and self.learner.mode == "feature"):
             why = "feature-parallel learner (columns are sharded, not rows)"
-        elif md.query_boundaries is not None:
-            why = "query groups pin rows to their rank"
         elif md.init_score is not None:
             why = "per-row init_score is not relocatable yet"
         if why is not None:
             Log.warning("rebalance=true ignored: %s", why)
             return
-        from ..parallel.collect import allgather_bytes
         from ..parallel.shardplan import RebalanceController, ShardPlan
 
-        counts = [
-            int.from_bytes(g, "little")
-            for g in allgather_bytes(
-                int(self.num_data).to_bytes(8, "little"),
-                purpose="rebalance")
-        ]
+        if rt is not None:
+            counts = list(rt.counts)
+            rank = rt.rank
+        else:
+            counts = [
+                int.from_bytes(g, "little")
+                for g in self._rebalance_gather(
+                    int(self.num_data).to_bytes(8, "little"))
+            ]
+            rank = _jax.process_index()
+        group_bounds = None
+        if md.query_boundaries is not None:
+            # query-grouped data (lambdarank): moves snap to whole query
+            # groups, so exchange the per-rank group sizes once and keep
+            # the cumulative GLOBAL group boundaries in the controller
+            sizes = np.diff(np.asarray(md.query_boundaries, np.int64))
+            blobs = self._rebalance_gather(
+                np.ascontiguousarray(sizes, np.int64).tobytes())
+            all_sizes = np.concatenate(
+                [np.frombuffer(b, np.int64) for b in blobs])
+            group_bounds = np.concatenate(([0], np.cumsum(all_sizes)))
         self._rebalance = {
             "plan": ShardPlan.from_counts(counts),
             "ctl": RebalanceController(
                 threshold=self.config.rebalance_threshold,
                 patience=self.config.rebalance_patience,
                 max_move_frac=self.config.rebalance_max_move_frac,
+                group_bounds=group_bounds,
             ),
-            "rank": _jax.process_index(),
+            "rank": rank,
+            "group_bounds": group_bounds,
         }
         Log.info(
             "Shard rebalancing armed: shards=%s threshold=%.2f "
-            "patience=%d max_move_frac=%.2f", counts,
+            "patience=%d max_move_frac=%.2f groups=%s", counts,
             self.config.rebalance_threshold,
             self.config.rebalance_patience,
             self.config.rebalance_max_move_frac,
+            "whole-query" if group_bounds is not None else "row",
         )
 
     def _maybe_rebalance(self, wall_s: float) -> None:
@@ -1052,13 +1211,13 @@ class GBDT:
         import json as _json
 
         from ..parallel import net as _net
-        from ..parallel.collect import allgather_bytes
 
         rb = self._rebalance
         wait_s = _net.wait_clock_drain()
         compute_s = max(wall_s - wait_s, 0.0)
         hb_age = 0.0
-        watch = _net.peer_watch()
+        watch = (self._membership.watch if self._membership is not None
+                 else _net.peer_watch())
         if watch is not None:
             ages = watch.ages()
             if ages:
@@ -1067,8 +1226,7 @@ class GBDT:
                  "hb_age": hb_age}
         table = [
             _json.loads(g)
-            for g in allgather_bytes(_json.dumps(entry).encode(),
-                                     purpose="rebalance")
+            for g in self._rebalance_gather(_json.dumps(entry).encode())
         ]
         plan = rb["plan"]
         new_plan = rb["ctl"].observe(
@@ -1106,7 +1264,9 @@ class GBDT:
             blocks["weights"] = (np.asarray(md.weights), 0)
         if getattr(self.train_set, "bundled", None) is not None:
             blocks["bundled"] = (np.asarray(self.train_set.bundled), 0)
-        moved = exchange_rows(old_plan, new_plan, rank, blocks)
+        comm = (self.learner.comm if self._membership is not None
+                else None)
+        moved = exchange_rows(old_plan, new_plan, rank, blocks, comm=comm)
         n_new = int(new_plan.counts[rank])
 
         self.train_set.binned = moved["binned"]
@@ -1128,6 +1288,14 @@ class GBDT:
             self.bins = jnp.asarray(self.train_set.binned)
         self.scores = jnp.asarray(moved["scores"])
         self.select = jnp.asarray(moved["select"])
+        gb = self._rebalance.get("group_bounds")
+        if gb is not None:
+            # whole-group cuts (snap_to_groups) guarantee the new range
+            # starts and ends on global group boundaries: re-derive the
+            # local query layout before the objective re-binds it
+            s, e = new_plan.rank_range(rank)
+            local_b = gb[(gb >= s) & (gb <= e)]
+            md.set_query(np.diff(local_b))
         # objective/metrics bind per-row device arrays at init
         if self.objective is not None:
             self.objective.init(md, n_new)
@@ -1149,6 +1317,280 @@ class GBDT:
         Log.info("Rebalanced shards at iteration %d: %s -> %s "
                  "(%d rows moved)", self.iter, list(old_plan.counts),
                  list(new_plan.counts), moved_rows)
+
+    # ------------------------------------------------------------------
+    # live elastic membership (parallel/membership.py)
+    # ------------------------------------------------------------------
+    def _maybe_membership(self) -> None:
+        """Iteration-boundary membership sync, in lockstep on every
+        member: a tiny intent allgather; on churn, drain into an epoch
+        transition at this boundary."""
+        decision = self._membership.sync()
+        if decision is not None:
+            self._apply_membership_change(decision)
+
+    def _membership_recover(self, err) -> None:
+        """A collective raised PeerFailureError: roll the partially-grown
+        iteration back, converge on who is still alive, and resize."""
+        rt = self._membership
+        dead = tuple(r for r in getattr(err, "ranks", ()) if r != rt.id)
+        Log.warning(
+            "Peer failure under elastic membership: %s — negotiating a "
+            "fleet resize (evidence: %s)", err, list(dead))
+        if not self._iter_complete:
+            self._membership_rollback_partial()
+        decision = rt.sync(known_dead=dead)
+        if decision is not None:
+            self._apply_membership_change(decision)
+
+    def _membership_rollback_partial(self) -> None:
+        """Undo partially-grown iteration state left by a mid-grow peer
+        failure so the retry replays from the boundary.  With one tree
+        per iteration nothing is ever partial (the grower fails before
+        the model is appended); multi-class iterations subtract the
+        already-scored classes back out via the full binned traversal."""
+        k = self.num_tree_per_iteration
+        complete = self.iter * k + (1 if self.boost_from_average_ else 0)
+        extra = self.models[complete:]
+        for kk, tree in enumerate(extra):
+            if tree.num_leaves > 1:
+                tree.shrinkage(-1.0)
+                self._add_tree_to_train_scores(tree, kk)
+                self._add_tree_to_valid_scores(tree, kk)
+        del self.models[complete:]
+        snap = getattr(self, "_member_iter_snapshot", None)
+        if snap is not None:
+            self.bag_rng.set_state(snap[0])
+            self.feature_rng.set_state(snap[1])
+            self.select = snap[2]
+
+    def _membership_capture(self):
+        """Snapshot this member's TrainState (ckpt.capture without the
+        Booster wrapper — same meta contract, so the canonical merge /
+        reshard machinery applies unchanged)."""
+        from ..ckpt.state import (FORMAT_VERSION, TrainState,
+                                  config_fingerprint, data_fingerprint,
+                                  data_fingerprint_parts, pack_trees)
+
+        arrays, py = self.export_train_state()
+        arrays.update(pack_trees(self.models))
+        meta = {
+            "format_version": FORMAT_VERSION,
+            "iteration": int(self.iter),
+            "boosting_type": type(self).__name__.lower(),
+            "num_models": len(self.models),
+            "num_tree_per_iteration": int(self.num_tree_per_iteration),
+            "num_data": int(self.num_data),
+            "config_fingerprint": config_fingerprint(self.config),
+            "data_fingerprint": data_fingerprint(self.train_set),
+            "data_fingerprint_parts": data_fingerprint_parts(self.train_set),
+            "num_valid": len(self.valid_scores),
+            "best_iteration": -1,
+        }
+        return TrainState(meta, py, arrays)
+
+    def _membership_replay_scores(self, binned) -> np.ndarray:
+        """Re-derive a (K, n) f32 score cache for re-binned rows by
+        replaying every tree in training accumulation order — one f32
+        add per tree, the exact sequence the rows' original owner ran,
+        so the replay is bit-identical to the scores it lost."""
+        k = self.num_tree_per_iteration
+        bins = jnp.asarray(binned)
+        scores = jnp.zeros((k, binned.shape[0]), jnp.float32)
+        offset = 1 if self.boost_from_average_ else 0
+        for i, tree in enumerate(self.models):
+            if tree.num_leaves <= 1:
+                continue  # empty alignment tree: nothing was added
+            kk = 0 if i < offset else (i - offset) % k
+            arrays = stack_trees([tree])
+            scores = scores.at[kk].add(
+                self._predict_binned_arrays(bins, arrays))
+        return np.asarray(scores, np.float32)
+
+    def _membership_synthesize(self, member: int, own_state):
+        """Reconstruct an evicted (SIGKILLed) member's TrainState without
+        its participation: regenerate its rows through the row_provider
+        seam, re-bin them with this member's mappers (identical on every
+        member — the pre-partition contract), and replay the score cache.
+        Deterministic, so every survivor synthesizes identical bytes."""
+        from ..ckpt.state import TrainState, combine_fingerprint_parts
+        from ..io.dataset import _bin_matrix
+        from ..parallel import net as _net
+        from ..parallel.shardplan import ShardPlan
+
+        rt = self._membership
+        if rt.row_provider is None:
+            raise _net.PeerFailureError(
+                f"cannot synthesize evicted member {member}'s shard: no "
+                "row_provider seam armed (MembershipRuntime.row_provider)")
+        if self.valid_scores:
+            raise _net.PeerFailureError(
+                "eviction with registered valid sets is not supported: "
+                "the dead member's valid-score shard is unrecoverable")
+        if type(self).__name__.lower() != "gbdt" and not getattr(
+                self, "supports_membership_synthesis", False):
+            raise _net.PeerFailureError(
+                f"eviction under boosting type {type(self).__name__} is "
+                "not supported: score replay assumes immutable past trees")
+        old_plan = ShardPlan.from_counts(rt.counts)
+        lo, hi = old_plan.rank_range(rt.members.index(member))
+        X, y = rt.row_provider(lo, hi)
+        ts = self.train_set
+        binned = _bin_matrix(np.asarray(X, np.float64), ts.bin_mappers,
+                             ts.used_feature_map)
+        label = np.asarray(y, np.asarray(ts.metadata.label).dtype)
+        n = int(binned.shape[0])
+        import zlib as _zlib
+
+        lab_bytes = np.ascontiguousarray(label).tobytes()
+        parts = {
+            "rows": n, "cols": int(binned.shape[1]),
+            "crc_binned": _zlib.crc32(
+                np.ascontiguousarray(binned).tobytes()) & 0xFFFFFFFF,
+            "len_binned": int(binned.nbytes),
+            "crc_label": _zlib.crc32(lab_bytes) & 0xFFFFFFFF,
+            "len_label": len(lab_bytes),
+        }
+        rs = np.random.RandomState(self.config.bagging_seed)
+        st = rs.get_state()
+        arrays = dict(own_state.arrays)
+        arrays["scores"] = self._membership_replay_scores(binned)
+        # bagging-off fleets never mutate the mask; under bagging the
+        # dead member's live mask is unrecoverable, so the reshard path's
+        # need_re_bagging forces a fresh draw before the mask is used
+        arrays["select"] = np.ones(n, np.float32)
+        arrays["bag_rng_keys"] = np.asarray(st[1], np.uint32)
+        py = dict(own_state.py)
+        py["bag_rng"] = [str(st[0]), int(st[2]), int(st[3]), float(st[4])]
+        py["need_re_bagging"] = True
+        meta = dict(own_state.meta)
+        meta["num_data"] = n
+        meta["data_fingerprint"] = combine_fingerprint_parts([parts])
+        meta["data_fingerprint_parts"] = parts
+        meta["best_iteration"] = -1
+        return TrainState(meta, py, arrays)
+
+    def _apply_membership_change(self, decision) -> None:
+        """One epoch transition, at an iteration boundary: gather every
+        living participant's TrainState, synthesize the evicted ones,
+        merge to the canonical global layout, commit the new epoch, and
+        reshard to this member's new slice — all in RAM, the PR-15
+        restart-time path made a runtime event."""
+        import time as _time
+
+        from ..ckpt import state as _ckpt
+        from ..parallel import membership as _mship
+        from ..parallel.shardplan import ShardPlan, _largest_remainder
+
+        rt = self._membership
+        t0 = _time.perf_counter()
+        own = self._membership_capture()
+        blobs = rt.gather_states(own.to_bytes(), decision.participants)
+        states = dict(zip(decision.participants,
+                          (_ckpt.TrainState.from_bytes(b) for b in blobs)))
+        for d in decision.dead:
+            states[d] = self._membership_synthesize(d, own)
+        ordered = [states[m] for m in rt.members]
+        canonical = _ckpt.merge_to_canonical(ordered)
+        if rt.id in decision.leavers:
+            # shard handed off; unwind out of the training loop
+            raise _mship.CleanLeave(rt.epoch + 1)
+        world = len(decision.new_members)
+        total = int(canonical.meta["num_data"])
+        counts = _largest_remainder([total / world] * world, total)
+        handoff = canonical.to_bytes() if decision.joiners else None
+        rt.commit_epoch(decision, counts, self.iter, total, handoff)
+        self._membership_adopt(canonical, counts)
+        pause = _time.perf_counter() - t0
+        tracer.gauge("member.resize_pause_s", pause)
+        self._membership_pauses.append(pause)
+        Log.info(
+            "Membership epoch %d at iteration %d: members=%s counts=%s "
+            "(rank %d/%d)", rt.epoch, self.iter, list(rt.members),
+            list(counts), rt.rank, rt.nproc)
+
+    def _membership_adopt(self, canonical, counts) -> None:
+        """Regenerate this member's new slice and restore its training
+        state from the canonical container (reshard in RAM)."""
+        from ..ckpt import state as _ckpt
+        from ..io.dataset import _bin_matrix
+        from ..parallel import collect as _collect
+        from ..parallel import net as _net
+        from ..parallel.shardplan import ShardPlan
+
+        rt = self._membership
+        # scope any collect.py gathers this process issues from here on
+        # to the adopted epoch (fresh uid subtree — net.epoch_uid)
+        _collect.set_epoch(rt.epoch)
+        plan = ShardPlan.from_counts(counts)
+        lo, hi = plan.rank_range(rt.rank)
+        ts = self.train_set
+        md = ts.metadata
+        X, y = rt.row_provider(lo, hi)
+        ts.binned = _bin_matrix(np.asarray(X, np.float64), ts.bin_mappers,
+                                ts.used_feature_map)
+        md.num_data = hi - lo
+        md.set_label(np.asarray(y))
+        for attr in ("_ckpt_fingerprint", "_ckpt_fp_parts"):
+            if getattr(ts, attr, None) is not None:
+                setattr(ts, attr, None)
+        self.num_data = hi - lo
+        if self.bins is not None:
+            self.bins = jnp.asarray(ts.binned)
+        # membership remaps member ids to ranks at every epoch: never
+        # resume a sibling's per-rank stream — force the resized path
+        canonical.meta.pop("shard_rows", None)
+        local_fp = _ckpt.combine_fingerprint_parts(
+            [_ckpt.data_fingerprint_parts(ts)])
+        state = _ckpt.reshard_to_local(
+            canonical, rt.rank, list(counts), [], local_fp,
+            bag_seed=self.config.bagging_seed)
+        self.models = _ckpt.unpack_trees(state.arrays)
+        self.import_train_state(state.arrays, state.py)
+        if self.objective is not None:
+            self.objective.init(md, self.num_data)
+        for metric in self.training_metrics:
+            metric.init(md, self.num_data)
+        if self.learner is not None and hasattr(self.learner, "set_plan"):
+            self.learner.set_plan(plan)
+        _net.set_delay_scale(self.num_data / max(self._initial_local_rows, 1))
+        if self._rebalance is not None:
+            self._rebalance["plan"] = plan
+            self._rebalance["rank"] = rt.rank
+            self._rebalance["ctl"].reset()
+
+    def _membership_join_restore(self) -> None:
+        """Mid-run joiner: adopt the canonical handoff the coordinator
+        published at admission.  The worker already built its Dataset for
+        the admitted slice, so this only restores trees + train state."""
+        from ..ckpt import state as _ckpt
+
+        rt = self._membership
+        if int(rt.counts[rt.rank]) != int(self.num_data):
+            Log.fatal(
+                "elastic join: this worker holds %d rows but epoch %d "
+                "assigns rank %d %d rows", self.num_data, rt.epoch,
+                rt.rank, int(rt.counts[rt.rank]))
+        canonical = _ckpt.TrainState.from_bytes(rt.read_handoff())
+        own_fp = _ckpt.config_fingerprint(self.config)
+        theirs = canonical.meta.get("config_fingerprint")
+        if theirs is not None and theirs != own_fp:
+            Log.fatal(
+                "elastic join: this worker's training config (fingerprint "
+                "%s) differs from the fleet's (%s) — a joiner must run the "
+                "identical parameters", own_fp, theirs)
+        canonical.meta.pop("shard_rows", None)
+        local_fp = _ckpt.combine_fingerprint_parts(
+            [_ckpt.data_fingerprint_parts(self.train_set)])
+        state = _ckpt.reshard_to_local(
+            canonical, rt.rank, list(rt.counts), [], local_fp,
+            bag_seed=self.config.bagging_seed)
+        self.models = _ckpt.unpack_trees(state.arrays)
+        self.import_train_state(state.arrays, state.py)
+        Log.info(
+            "Joined fleet at epoch %d, iteration %d: rank %d/%d, %d "
+            "rows, %d trees", rt.epoch, self.iter, rt.rank, rt.nproc,
+            self.num_data, len(self.models))
 
     def export_train_state(self):
         """Checkpoint hook (ckpt/state.py): everything beyond the

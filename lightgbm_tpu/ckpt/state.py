@@ -88,6 +88,10 @@ _FP_VOLATILE = {
     # per-iteration math on a given shard layout.
     "num_machines", "rebalance", "rebalance_threshold",
     "rebalance_patience", "rebalance_max_move_frac",
+    # live membership is a transport/topology property, not math: a
+    # checkpoint written by an elastic fleet must resume on a static
+    # one and vice versa (parallel/membership.py, docs/ROBUSTNESS.md)
+    "elastic_membership",
 }
 
 

@@ -303,6 +303,17 @@ class Config:
     rebalance_threshold: float = 1.5
     rebalance_patience: int = 3
     rebalance_max_move_frac: float = 0.25
+    # --- live elastic membership (parallel/membership.py;
+    # docs/ROBUSTNESS.md).  Off by default: elastic_membership=False
+    # compiles the exact static-fleet path (jax.distributed transport,
+    # documented bounded fail-fast on coordinator death).  When on, the
+    # worker must have armed a MembershipRuntime (or set
+    # LIGHTGBM_TPU_MEMBER_DIR) before Booster construction; collectives
+    # then ride the shared-directory KV fleet, workers may join/leave
+    # mid-run at iteration boundaries, and a dead member is evicted
+    # (survivors resize via the in-RAM canonical merge/reshard path)
+    # instead of the whole fleet exiting 75.
+    elastic_membership: bool = False
 
     # --- derived
     is_parallel: bool = False
@@ -485,6 +496,18 @@ class Config:
         if not (0.0 < self.rebalance_max_move_frac <= 1.0):
             Log.fatal("rebalance_max_move_frac must be in (0, 1], got %s",
                       self.rebalance_max_move_frac)
+        if self.elastic_membership:
+            if self.tree_learner not in ("data", "serial"):
+                Log.fatal(
+                    "elastic_membership=true requires tree_learner=data "
+                    "(got %s): feature-parallel shards columns, and a "
+                    "membership change re-partitions ROWS through the "
+                    "canonical merge/reshard path.", self.tree_learner)
+            if self.num_machines > 1:
+                Log.fatal(
+                    "elastic_membership=true cannot run with "
+                    "num_machines=%d: the membership fleet replaces the "
+                    "static socket world.", self.num_machines)
         Log.reset_level(self.verbose)
 
 

@@ -10,7 +10,9 @@ CRC'd file (factory/state.py) so a kill anywhere restarts into the
 same run without double-publishing or losing a verdict.
 """
 
+from .spot import CostLedger, SpotFleet, SpotSchedule
 from .state import FactoryState
 from .supervisor import DEFAULTS, FactorySupervisor, main
 
-__all__ = ["FactoryState", "FactorySupervisor", "DEFAULTS", "main"]
+__all__ = ["CostLedger", "FactoryState", "FactorySupervisor", "DEFAULTS",
+           "SpotFleet", "SpotSchedule", "main"]

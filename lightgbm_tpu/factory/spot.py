@@ -1,0 +1,469 @@
+"""Preemptible-capacity economics loop (docs/FACTORY.md, ``spot``).
+
+The elastic membership runtime (parallel/membership.py) makes worker
+death a RESIZE instead of a job restart — which turns preemptible
+(spot) capacity from a reliability hazard into a price discount.  This
+module closes that loop and measures it:
+
+``SpotSchedule``
+    A deterministic price + preemption trace: either scripted
+    (``from_script``, exact timings for tests and the bench) or sampled
+    (``sample``, seeded Poisson arrivals) — never wall-clock random at
+    run time, so a trace can be replayed.
+
+``CostLedger``
+    An atomic (tmp+rename, single JSON document) ledger of fleet spend:
+    per-member member-seconds priced by the trace, every preemption /
+    spawn event, and fleet-wide iteration completions harvested from
+    the membership KV store.  ``zero_lost_iterations`` proves the
+    economic premise — survivors resized in RAM, no completed iteration
+    was redone or discarded.
+
+``SpotFleet``
+    Drives REAL worker subprocesses (tests/membership_worker.py by
+    default) over one shared fleet directory: a ``preempt`` event
+    SIGKILLs a live member mid-iteration, a ``spawn`` event launches a
+    mid-run joiner that auto-resumes from the coordinator's handoff,
+    and the fleet's survivors keep training throughout.
+
+``python -m lightgbm_tpu factory spot fleet=DIR ...`` runs one fleet
+against a schedule and prints the ledger; ``baseline=1`` runs the
+static on-demand reference instead so the two ledgers can be compared
+(``factory.cost_per_model`` vs ``factory.cost_baseline``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..obs import tracer
+from ..utils.log import Log
+
+#: on-demand price of one member for one second — the unit every spot
+#: price in a trace is a fraction of
+ON_DEMAND_PRICE = 1.0
+
+
+# ----------------------------------------------------------------------
+# schedule
+# ----------------------------------------------------------------------
+@dataclass(frozen=True)
+class SpotEvent:
+    """One point on the capacity/price trace.
+
+    kind ``price``   — the spot price becomes ``value`` at ``t_s``
+    kind ``preempt`` — SIGKILL a live member at ``t_s`` (``target`` is a
+                       bootstrap member id, or None for the youngest)
+    kind ``spawn``   — launch a mid-run joiner at ``t_s``
+    """
+
+    t_s: float
+    kind: str
+    value: float = 0.0
+    target: Optional[int] = None
+
+
+class SpotSchedule:
+    """Deterministic price + preemption trace (sorted :class:`SpotEvent`
+    list over a base price).  Replayable by construction: randomness is
+    only ever drawn in :meth:`sample` from an explicit seed."""
+
+    KINDS = ("price", "preempt", "spawn")
+
+    def __init__(self, events: List[SpotEvent], base_price: float = 0.3):
+        for ev in events:
+            if ev.kind not in self.KINDS:
+                raise ValueError(f"unknown spot event kind {ev.kind!r}")
+        self.events = sorted(events, key=lambda e: (e.t_s, e.kind))
+        self.base_price = float(base_price)
+
+    @classmethod
+    def from_script(cls, script: str, base_price: float = 0.3):
+        """``"preempt@2.5;spawn@4;price@6=0.5;preempt@8=1"`` — kind at
+        time, ``=N`` is a price for ``price`` and a target member id for
+        ``preempt``."""
+        events = []
+        for tok in script.split(";"):
+            tok = tok.strip()
+            if not tok:
+                continue
+            kind, _, rest = tok.partition("@")
+            when, _, arg = rest.partition("=")
+            kind = kind.strip()
+            if kind not in cls.KINDS or not when:
+                raise ValueError(f"bad spot script token {tok!r}")
+            if kind == "price" and not arg:
+                raise ValueError(
+                    f"price event needs a value (price@T=P): {tok!r}")
+            value, target = 0.0, None
+            if arg:
+                if kind == "price":
+                    value = float(arg)
+                elif kind == "preempt":
+                    target = int(arg)
+                else:
+                    raise ValueError(f"bad spot script token {tok!r}")
+            events.append(SpotEvent(float(when), kind, value, target))
+        return cls(events, base_price)
+
+    @classmethod
+    def sample(cls, seed: int, horizon_s: float, preempt_hz: float = 0.1,
+               spawn_hz: float = 0.1, base_price: float = 0.3,
+               volatility: float = 0.25, price_step_s: float = 5.0):
+        """Seeded Poisson preempt/spawn arrivals over a clipped
+        random-walk price — the same seed always yields the same trace."""
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        events: List[SpotEvent] = []
+        for kind, hz in (("preempt", preempt_hz), ("spawn", spawn_hz)):
+            t = 0.0
+            while True:
+                t += float(rng.exponential(1.0 / hz)) if hz > 0 else horizon_s
+                if t >= horizon_s:
+                    break
+                events.append(SpotEvent(round(t, 3), kind))
+        price, t = base_price, price_step_s
+        while t < horizon_s:
+            price = float(np.clip(
+                price * (1.0 + volatility * rng.standard_normal()),
+                0.05 * base_price, ON_DEMAND_PRICE))
+            events.append(SpotEvent(round(t, 3), "price", round(price, 4)))
+            t += price_step_s
+        return cls(events, base_price)
+
+    def price_at(self, t_s: float) -> float:
+        price = self.base_price
+        for ev in self.events:
+            if ev.kind == "price" and ev.t_s <= t_s:
+                price = ev.value
+        return price
+
+    def due(self, t_prev: float, t_now: float) -> List[SpotEvent]:
+        """Capacity events (preempt/spawn) with ``t_prev < t_s <= t_now``."""
+        return [ev for ev in self.events
+                if ev.kind != "price" and t_prev < ev.t_s <= t_now]
+
+
+# ----------------------------------------------------------------------
+# ledger
+# ----------------------------------------------------------------------
+class CostLedger:
+    """Atomic single-document JSON ledger (tmp + fsync + rename, the
+    checkpoint-store publish idiom): a SIGKILL of the fleet driver at
+    any instant leaves either the previous or the next complete ledger
+    on disk, never a torn one.  Format documented in docs/FACTORY.md."""
+
+    VERSION = 1
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        self._doc = {
+            "version": self.VERSION,
+            "member_seconds": {},   # member key -> seconds alive
+            "cost": {},             # member key -> priced spend
+            "events": [],           # preempt/spawn/price changes, timed
+            "iterations": {},       # iter -> {"epoch": E, "t_s": ...}
+            "total_cost": 0.0,
+            "completed": False,
+            "trees": None,
+        }
+
+    # -- mutation ------------------------------------------------------
+    def charge(self, member, dt_s: float, price: float) -> None:
+        key = str(member)
+        self._doc["member_seconds"][key] = (
+            self._doc["member_seconds"].get(key, 0.0) + dt_s)
+        self._doc["cost"][key] = (
+            self._doc["cost"].get(key, 0.0) + dt_s * price)
+        self._doc["total_cost"] = sum(self._doc["cost"].values())
+
+    def event(self, t_s: float, kind: str, **attrs) -> None:
+        self._doc["events"].append(dict(t_s=round(t_s, 3), kind=kind,
+                                        **attrs))
+
+    def iteration(self, it: int, epoch: int, t_s: float) -> None:
+        self._doc["iterations"].setdefault(
+            str(it), {"epoch": epoch, "t_s": round(t_s, 3)})
+
+    def finish(self, trees: int) -> None:
+        self._doc["completed"] = True
+        self._doc["trees"] = int(trees)
+
+    # -- queries -------------------------------------------------------
+    @property
+    def total_cost(self) -> float:
+        return float(self._doc["total_cost"])
+
+    def zero_lost_iterations(self) -> bool:
+        """Every trained iteration 0..trees-1 was completed exactly once
+        fleet-wide (the per-iteration KV records are write-once, so a
+        redone iteration could not re-claim its slot)."""
+        trees = self._doc["trees"]
+        if not self._doc["completed"] or trees is None:
+            return False
+        got = sorted(int(k) for k in self._doc["iterations"])
+        return got == list(range(int(trees)))
+
+    def cost_per_model(self) -> Optional[float]:
+        return self.total_cost if self._doc["completed"] else None
+
+    # -- persistence ---------------------------------------------------
+    def flush(self) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(self._doc, fh, indent=1, sort_keys=True)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.path)
+
+    @classmethod
+    def load(cls, path: str) -> "CostLedger":
+        ledger = cls(path)
+        with open(path) as fh:
+            doc = json.load(fh)
+        if doc.get("version") != cls.VERSION:
+            raise ValueError(
+                f"cost ledger {path}: version {doc.get('version')!r} "
+                f"(supported: {cls.VERSION})")
+        ledger._doc = doc
+        return ledger
+
+
+# ----------------------------------------------------------------------
+# fleet driver
+# ----------------------------------------------------------------------
+def _default_worker() -> str:
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(repo, "tests", "membership_worker.py")
+
+
+class SpotFleet:
+    """Run one elastic training fleet of REAL subprocesses against a
+    :class:`SpotSchedule`, pricing every member-second into a
+    :class:`CostLedger`.
+
+    The driver only ever sends signals and reads the shared KV store —
+    all recovery (eviction, resize, join restore) is the workers' own
+    membership runtime, exactly as it would be under a cloud scheduler.
+    """
+
+    def __init__(self, fleet_dir: str, schedule: SpotSchedule, nproc: int,
+                 ledger_path: str, trees: int = 12, rows: int = 600,
+                 worker: Optional[str] = None,
+                 extra_env: Optional[Dict[str, str]] = None,
+                 poll_s: float = 0.2):
+        self.fleet_dir = os.path.abspath(fleet_dir)
+        self.schedule = schedule
+        self.nproc = int(nproc)
+        self.trees = int(trees)
+        self.rows = int(rows)
+        self.worker = worker or _default_worker()
+        self.extra_env = dict(extra_env or {})
+        self.poll_s = float(poll_s)
+        self.ledger = CostLedger(ledger_path)
+        self.out = os.path.join(self.fleet_dir, "out")
+        self._procs: List[dict] = []  # {proc, key, kind, alive}
+        self._spawned_joiners = 0
+
+    # -- workers -------------------------------------------------------
+    def _env(self) -> Dict[str, str]:
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("LIGHTGBM_TPU_", "MEMBER_", "XLA_"))}
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = (
+            os.path.dirname(os.path.dirname(os.path.abspath(self.worker)))
+            + os.pathsep + env.get("PYTHONPATH", ""))
+        env["LIGHTGBM_TPU_NET_TIMEOUT"] = env.get(
+            "LIGHTGBM_TPU_NET_TIMEOUT", "8")
+        env.update({
+            "MEMBER_NPROC": str(self.nproc),
+            "MEMBER_ROWS": str(self.rows),
+            "MEMBER_TREES": str(self.trees),
+            "MEMBER_PROGRESS": "1",
+            # pace iterations so scripted event times land mid-run even
+            # on a fast box; the ledger prices member-seconds, so pacing
+            # inflates spot and baseline identically
+            "MEMBER_ITER_SLEEP": env.get("MEMBER_ITER_SLEEP", "0.3"),
+        })
+        env.update(self.extra_env)
+        return env
+
+    def _spawn(self, member_arg) -> dict:
+        proc = subprocess.Popen(
+            [sys.executable, self.worker, str(member_arg), self.fleet_dir,
+             self.out],
+            env=self._env(), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        key = str(member_arg)
+        if member_arg == "join":
+            # ledger keys must be unique per worker, not per argv form
+            key = f"join{sum(1 for r in self._procs if r['kind'] == 'join') + 1}"
+        rec = dict(proc=proc, key=key, kind=(
+            "join" if member_arg == "join" else "bootstrap"))
+        self._procs.append(rec)
+        return rec
+
+    def _live(self) -> List[dict]:
+        return [r for r in self._procs if r["proc"].poll() is None]
+
+    def _preempt(self, ev: SpotEvent, t: float) -> None:
+        live = self._live()
+        victim = None
+        if ev.target is not None:
+            victim = next((r for r in live if r["key"] == str(ev.target)),
+                          None)
+        elif live:
+            victim = live[-1]  # youngest capacity goes first
+        if victim is None:
+            Log.warning("spot: preempt@%.1fs found no live member", ev.t_s)
+            return
+        victim["proc"].send_signal(signal.SIGKILL)
+        victim["proc"].wait()
+        tracer.event("spot.preempt", member=victim["key"], t_s=round(t, 3))
+        self.ledger.event(t, "preempt", member=victim["key"])
+
+    def _spawn_joiner(self, t: float) -> None:
+        self._spawned_joiners += 1
+        self._spawn("join")
+        tracer.event("spot.spawn", ordinal=self._spawned_joiners,
+                     t_s=round(t, 3))
+        self.ledger.event(t, "spawn", ordinal=self._spawned_joiners)
+
+    # -- progress ------------------------------------------------------
+    def _harvest_progress(self, t: float) -> None:
+        from ..parallel.membership import FileKVClient
+
+        client = FileKVClient(os.path.join(self.fleet_dir, "kv"))
+        for key, value in client.key_value_dir_get("progress/"):
+            it = int(key.rsplit("/", 1)[-1])
+            try:
+                epoch = int(json.loads(value)["epoch"])
+            except (ValueError, KeyError, TypeError):
+                epoch = -1
+            self.ledger.iteration(it, epoch, t)
+
+    # -- run -----------------------------------------------------------
+    def run(self, timeout_s: float = 300.0) -> dict:
+        os.makedirs(self.fleet_dir, exist_ok=True)
+        for m in range(self.nproc):
+            self._spawn(m)
+        t0 = time.monotonic()
+        last = 0.0
+        self.ledger.event(0.0, "price", price=self.schedule.base_price)
+        while True:
+            time.sleep(self.poll_s)
+            t = time.monotonic() - t0
+            price = self.schedule.price_at(t)
+            for rec in self._live():
+                self.ledger.charge(rec["key"], t - last, price)
+            for ev in self.schedule.due(last, t):
+                if ev.kind == "preempt":
+                    self._preempt(ev, t)
+                elif ev.kind == "spawn":
+                    self._spawn_joiner(t)
+            self._harvest_progress(t)
+            self.ledger.flush()
+            last = t
+            if not self._live():
+                break
+            if t > timeout_s:
+                Log.warning("spot: fleet timeout after %.0fs — killing", t)
+                for rec in self._live():
+                    rec["proc"].kill()
+                break
+        wall = time.monotonic() - t0
+        results = self._collect()
+        if results["models"]:
+            self.ledger.finish(self.trees)
+        self.ledger.event(wall, "done", completed=bool(results["models"]))
+        self.ledger.flush()
+        cost = self.ledger.cost_per_model()
+        if cost is not None:
+            tracer.gauge("factory.cost_per_model", cost,
+                         fleet=os.path.basename(self.fleet_dir))
+        return dict(wall_s=round(wall, 3), cost=cost,
+                    zero_lost_iterations=self.ledger.zero_lost_iterations(),
+                    ledger=self.ledger.path, **results)
+
+    def _collect(self) -> dict:
+        exits, models, metas = {}, {}, {}
+        for rec in self._procs:
+            rec["proc"].communicate()
+            exits[rec["key"]] = rec["proc"].returncode
+        for name in sorted(os.listdir(self.fleet_dir)):
+            if name.startswith("out.m") and name.endswith(".txt"):
+                mid = name[len("out.m"):-len(".txt")]
+                with open(os.path.join(self.fleet_dir, name)) as fh:
+                    models[mid] = fh.read()
+            elif name.startswith("out.m") and name.endswith(".json"):
+                mid = name[len("out.m"):-len(".json")]
+                with open(os.path.join(self.fleet_dir, name)) as fh:
+                    metas[mid] = json.load(fh)
+        return dict(exits=exits, models=models, metas=metas)
+
+
+def run_static_baseline(fleet_dir: str, nproc: int, ledger_path: str,
+                        trees: int = 12, rows: int = 600,
+                        worker: Optional[str] = None,
+                        extra_env: Optional[Dict[str, str]] = None,
+                        timeout_s: float = 300.0) -> dict:
+    """The on-demand reference: the same fleet with no churn, every
+    member-second priced at :data:`ON_DEMAND_PRICE`."""
+    fleet = SpotFleet(fleet_dir, SpotSchedule([], base_price=ON_DEMAND_PRICE),
+                      nproc, ledger_path, trees=trees, rows=rows,
+                      worker=worker, extra_env=extra_env)
+    summary = fleet.run(timeout_s=timeout_s)
+    if summary["cost"] is not None:
+        tracer.gauge("factory.cost_baseline", summary["cost"],
+                     fleet=os.path.basename(os.path.abspath(fleet_dir)))
+    return summary
+
+
+# ----------------------------------------------------------------------
+# ``factory spot`` subcommand
+# ----------------------------------------------------------------------
+def main(argv: List[str]) -> int:
+    """``python -m lightgbm_tpu factory spot fleet=DIR [nproc=3]
+    [trees=12] [rows=600] [script=preempt@3;spawn@6] [seed=N]
+    [horizon=30] [price=0.3] [baseline=1] [ledger=PATH]``."""
+    from ..cli import parse_argv
+    from .supervisor import EXIT_BAD_ARGS, EXIT_OK
+
+    tracer.refresh_from_env()
+    params = parse_argv(argv)
+    fleet_dir = params.get("fleet")
+    if not fleet_dir:
+        Log.warning("factory spot: need fleet=DIR [nproc=3] [trees=12] "
+                    "[script=...|seed=N] [price=0.3] [baseline=1]")
+        return EXIT_BAD_ARGS
+    nproc = int(params.get("nproc", "3"))
+    trees = int(params.get("trees", "12"))
+    rows = int(params.get("rows", "600"))
+    price = float(params.get("price", "0.3"))
+    ledger = params.get("ledger",
+                        os.path.join(fleet_dir, "cost_ledger.json"))
+    if params.get("baseline", "0") == "1":
+        summary = run_static_baseline(fleet_dir, nproc, ledger, trees=trees,
+                                      rows=rows)
+    else:
+        if "script" in params:
+            schedule = SpotSchedule.from_script(params["script"], price)
+        else:
+            schedule = SpotSchedule.sample(
+                int(params.get("seed", "0")),
+                float(params.get("horizon", "30")), base_price=price)
+        fleet = SpotFleet(fleet_dir, schedule, nproc, ledger, trees=trees,
+                          rows=rows)
+        summary = fleet.run()
+    print(json.dumps({k: v for k, v in summary.items() if k != "models"},
+                     indent=1, sort_keys=True))
+    return EXIT_OK if summary["cost"] is not None else 1

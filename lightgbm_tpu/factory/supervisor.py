@@ -479,6 +479,11 @@ def main(argv: List[str]) -> int:
     exits non-zero and a restart resumes the interrupted run."""
     from ..cli import parse_argv
 
+    if argv and argv[0] == "spot":
+        # preemptible-capacity economics loop (factory/spot.py)
+        from .spot import main as spot_main
+
+        return spot_main(argv[1:])
     tracer.refresh_from_env()
     params = parse_argv(argv)
     data_dir = params.pop("data", None)

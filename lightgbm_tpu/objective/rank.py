@@ -44,11 +44,25 @@ def max_dcg_at_k(k: int, labels: np.ndarray, label_gain: np.ndarray) -> float:
     return float(np.sum(gains * disc[: len(gains)]))
 
 
-def pad_queries(query_boundaries: np.ndarray):
-    """(Q, S) padded doc-index matrix + (Q, S) valid mask + (Q,) counts."""
+def pad_queries(query_boundaries: np.ndarray, pad_to: int | None = None):
+    """(Q, S) padded doc-index matrix + (Q, S) valid mask + (Q,) counts.
+
+    ``pad_to`` overrides the pad width S.  Sharded training MUST pass the
+    GLOBAL max group size here: padding to the local max would give each
+    world size (and each post-rebalance shard) a different (Q, S, S)
+    program shape, hence a different f32 reduction order and ulp-level
+    gradient drift that quantized stochastic rounding amplifies into
+    different trees.  The global max is a dataset constant, invariant
+    under whole-group moves, so one gather at init covers every reshard.
+    """
     q = len(query_boundaries) - 1
     sizes = np.diff(query_boundaries)
     s = int(sizes.max()) if q else 1
+    if pad_to is not None:
+        if pad_to < s:
+            Log.fatal("pad_queries: pad_to=%d below local max group size %d",
+                      int(pad_to), s)
+        s = int(pad_to)
     doc_idx = np.zeros((q, s), dtype=np.int32)
     valid = np.zeros((q, s), dtype=bool)
     for i in range(q):
@@ -78,7 +92,8 @@ class LambdarankNDCG(ObjectiveFunction):
         qb = np.asarray(metadata.query_boundaries, np.int64)
         lab = np.asarray(metadata.label, np.float32)
         self.num_queries = len(qb) - 1
-        doc_idx, valid, sizes = pad_queries(qb)
+        doc_idx, valid, sizes = pad_queries(
+            qb, getattr(metadata, "pad_group_size", None))
         s = doc_idx.shape[1]
         # inverse max DCG per query (hpp:58-69)
         inv = np.zeros(self.num_queries, np.float64)

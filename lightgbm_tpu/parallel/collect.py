@@ -42,13 +42,29 @@ from . import net
 # program order, so the counter yields matching keys across ranks (and
 # net.kv_gather's lazy GC relies on exactly that ordering)
 _kv_uid = itertools.count()
+# membership-epoch scope for the uids (net.epoch_uid layout): a static
+# world stays at 0 — bare sequence numbers, unchanged wire keys.  An
+# elastic transition calls set_epoch so post-resize gathers land in a
+# fresh uid subtree and can never read a stale pre-transition payload.
+_kv_epoch = 0
+
+
+def set_epoch(epoch: int) -> None:
+    """Scope subsequent KV-gather uids to a membership epoch.  The
+    per-epoch sequence restarts only on a real bump — re-announcing the
+    current epoch must NOT reuse uids."""
+    global _kv_epoch, _kv_uid
+    epoch = int(epoch)
+    if epoch != _kv_epoch:
+        _kv_epoch = epoch
+        _kv_uid = itertools.count()
 
 
 def _kv_allgather(blob: bytes) -> List[bytes]:
     import jax
 
     return net.kv_gather(
-        next(_kv_uid), blob,
+        net.epoch_uid(_kv_epoch, next(_kv_uid)), blob,
         client=net.require_client(),
         rank=jax.process_index(), nproc=jax.process_count(),
     )

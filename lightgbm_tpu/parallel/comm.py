@@ -37,6 +37,13 @@ from ..obs import tracer
 class Comm:
     """Allgather-of-bytes surface with a purpose-tagged byte ledger."""
 
+    #: membership epoch this communicator's collectives are scoped to.
+    #: Static worlds never bump it; the elastic MembershipComm
+    #: (parallel/membership.py) overrides it with the live runtime
+    #: epoch, so learners can stamp epoch-sensitive state without
+    #: knowing which transport they ride.
+    epoch = 0
+
     def __init__(self, rank: int, nproc: int):
         self.rank = int(rank)
         self.nproc = int(nproc)

@@ -236,3 +236,16 @@ def replicated_array(value, mesh):
         return jnp.asarray(value)
     sharding = NamedSharding(mesh, P())
     return jax.make_array_from_process_local_data(sharding, np.asarray(value))
+
+
+def current_epoch() -> int:
+    """The live membership epoch of this process's fleet — the
+    generation stamp elastic transitions bump (parallel/membership.py).
+    Static jax.distributed worlds and unarmed runs report 0, so any
+    caller can stamp epoch-sensitive state (collect.py uid scoping,
+    checkpoint meta, observability rows) without caring whether the
+    world is elastic."""
+    from . import membership
+
+    rt = membership.runtime()
+    return max(rt.epoch, 0) if rt is not None else 0

@@ -64,6 +64,31 @@ _HB_DIR = "ltpu_hb/"
 _COLLECT_DIR = "ltpu_collect/"
 _CHUNK_DIR = "ltpu_chunk/"
 
+# Epoch-scoped collective uid layout, shared by every issuer of
+# kv_gather uids (membership.py namespaces, collect.py gathers): bits
+# [EPOCH_SHIFT, EPOCH_SHIFT + EPOCH_BITS) carry the membership epoch,
+# the low bits the per-epoch sequence/participant digest, bits above
+# the purpose namespace.  Scoping uids by epoch means a collective
+# retried after a live-membership resize can never read a stale
+# pre-transition payload — the key subtrees are disjoint by
+# construction, and the coordinator's commit-time GC can reap a whole
+# superseded epoch by its uid field alone.
+EPOCH_SHIFT = 40
+EPOCH_BITS = 18
+
+
+def epoch_uid(epoch: int, seq: int, ns: int = 0) -> int:
+    """Compose ``ns | epoch-field | seq`` for an epoch-scoped collective."""
+    epoch = int(epoch)
+    if not 0 <= epoch < (1 << EPOCH_BITS):
+        raise ValueError(f"epoch {epoch} outside the uid epoch field")
+    return int(ns) | (epoch << EPOCH_SHIFT) | int(seq)
+
+
+def uid_epoch(uid: int) -> int:
+    """The epoch field of an epoch-scoped uid (0 for static-world uids)."""
+    return (int(uid) >> EPOCH_SHIFT) & ((1 << EPOCH_BITS) - 1)
+
 
 def _flight_dump(reason: str, error: Optional[BaseException] = None,
                  **attrs) -> None:

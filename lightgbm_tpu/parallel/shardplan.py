@@ -29,14 +29,16 @@ sizes shards inversely to per-row cost, moving at most
 from __future__ import annotations
 
 import dataclasses
-import pickle
+import struct
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..utils.log import Log
 
-__all__ = ["ShardPlan", "RebalanceController", "exchange_rows"]
+__all__ = ["ShardPlan", "RebalanceController", "exchange_rows",
+           "snap_to_groups"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -88,13 +90,20 @@ class RebalanceController:
 
     def __init__(self, threshold: float, patience: int,
                  max_move_frac: float, alpha: float = 0.3,
-                 stale_s: float = 10.0, min_rows: int = 32):
+                 stale_s: float = 10.0, min_rows: int = 32,
+                 group_bounds: Optional[np.ndarray] = None):
         self.threshold = float(threshold)
         self.patience = int(patience)
         self.max_move_frac = float(max_move_frac)
         self.alpha = float(alpha)
         self.stale_s = float(stale_s)
         self.min_rows = int(min_rows)
+        # cumulative global query-group boundaries (0 ... total,
+        # ascending).  When set, proposed shard cuts snap to the nearest
+        # boundary so no query group is ever split across ranks — the
+        # ranking objectives (lambdarank) need whole groups per rank.
+        self.group_bounds = (None if group_bounds is None
+                             else np.asarray(group_bounds, np.int64))
         self._ewma: Optional[List[float]] = None
         self._hot = 0
 
@@ -161,8 +170,19 @@ class RebalanceController:
             scaled = [c + (i - c) * scale
                       for c, i in zip(plan.counts, ideal)]
             ideal = _largest_remainder(scaled, total)
-        floor = min(self.min_rows, max(total // (2 * plan.world), 1))
-        ideal = _apply_floor(ideal, floor, total)
+        if self.group_bounds is not None:
+            # query-grouped data: the 32-row floor is replaced by
+            # cut-point snapping — the cumulative group boundaries are
+            # invariant under row moves, so every rank derives the same
+            # snapped cuts from the same ideal counts
+            cuts = snap_to_groups(np.cumsum(ideal)[:-1], self.group_bounds)
+            if cuts is None:
+                return None
+            edges = [0] + list(cuts) + [total]
+            ideal = [edges[i + 1] - edges[i] for i in range(plan.world)]
+        else:
+            floor = min(self.min_rows, max(total // (2 * plan.world), 1))
+            ideal = _apply_floor(ideal, floor, total)
         return ShardPlan.from_counts(ideal)
 
 
@@ -174,6 +194,35 @@ def _largest_remainder(shares: List[float], total: int) -> List[int]:
     for k in range(rem):
         base[order[k % len(order)]] += 1
     return base
+
+
+def snap_to_groups(cum_targets, group_bounds) -> Optional[Tuple[int, ...]]:
+    """Snap ideal cumulative cut points to the nearest query-group
+    boundary, keeping the cuts strictly increasing and strictly inside
+    ``(0, total)``.  Ties break toward the lower boundary; collisions
+    push the later cut to the next greater boundary.  Returns ``None``
+    when there are fewer interior boundaries than cuts (a rank would
+    own zero groups) — the caller holds position instead of moving."""
+    gb = np.asarray(group_bounds, np.int64)
+    total = int(gb[-1])
+    interior = gb[(gb > 0) & (gb < total)]
+    cuts: List[int] = []
+    prev = 0
+    for t in cum_targets:
+        cand = interior[interior > prev]
+        if cand.size == 0:
+            return None
+        i = int(np.searchsorted(cand, int(t)))
+        if i == 0:
+            pick = int(cand[0])
+        elif i >= cand.size:
+            pick = int(cand[-1])
+        else:
+            lo, hi = int(cand[i - 1]), int(cand[i])
+            pick = lo if int(t) - lo <= hi - int(t) else hi
+        cuts.append(pick)
+        prev = pick
+    return tuple(cuts)
 
 
 def _apply_floor(counts: List[int], floor: int, total: int) -> List[int]:
@@ -194,6 +243,75 @@ def _apply_floor(counts: List[int], floor: int, total: int) -> List[int]:
 
 
 # ----------------------------------------------------------------------
+# row-block wire: framed raw-numpy bytes (no pickle on the wire)
+# ----------------------------------------------------------------------
+# Same framing idea as the quantized ``hist_q`` histogram wire: fixed
+# struct headers + a CRC32 over each array payload, so a corrupted or
+# truncated blob fails loudly instead of deserializing garbage.  The
+# payload is the raw C-order buffer — byte-for-byte reproducible, which
+# the round-trip test pins.
+_RB_MAGIC = b"RB1\x00"
+_RB_HDR = struct.Struct("<I")          # span count
+_RB_SPAN = struct.Struct("<qqI")       # g0, g1, piece count
+_RB_PIECE = struct.Struct("<HHBB")     # name len, dtype len, axis, ndim
+
+
+def _pack_row_wire(outgoing: Dict[Tuple[int, int], Dict[str, np.ndarray]]
+                   ) -> bytes:
+    parts = [_RB_MAGIC, _RB_HDR.pack(len(outgoing))]
+    for (g0, g1) in sorted(outgoing):
+        blocks = outgoing[(g0, g1)]
+        parts.append(_RB_SPAN.pack(g0, g1, len(blocks)))
+        for name in sorted(blocks):
+            arr = np.ascontiguousarray(blocks[name])
+            nb = name.encode("utf-8")
+            db = arr.dtype.str.encode("ascii")
+            payload = arr.tobytes()
+            parts.append(_RB_PIECE.pack(len(nb), len(db), 0, arr.ndim))
+            parts.append(nb)
+            parts.append(db)
+            parts.append(struct.pack(f"<{arr.ndim}q", *arr.shape))
+            parts.append(struct.pack("<QI", len(payload),
+                                     zlib.crc32(payload)))
+            parts.append(payload)
+    return b"".join(parts)
+
+
+def _unpack_row_wire(blob: bytes
+                     ) -> Dict[Tuple[int, int], Dict[str, np.ndarray]]:
+    if blob[:len(_RB_MAGIC)] != _RB_MAGIC:
+        raise ValueError("rebalance wire: bad magic")
+    off = len(_RB_MAGIC)
+    (n_spans,) = _RB_HDR.unpack_from(blob, off)
+    off += _RB_HDR.size
+    out: Dict[Tuple[int, int], Dict[str, np.ndarray]] = {}
+    for _ in range(n_spans):
+        g0, g1, n_pieces = _RB_SPAN.unpack_from(blob, off)
+        off += _RB_SPAN.size
+        blocks: Dict[str, np.ndarray] = {}
+        for _p in range(n_pieces):
+            nlen, dlen, _axis, ndim = _RB_PIECE.unpack_from(blob, off)
+            off += _RB_PIECE.size
+            name = blob[off:off + nlen].decode("utf-8")
+            off += nlen
+            dtype = np.dtype(blob[off:off + dlen].decode("ascii"))
+            off += dlen
+            shape = struct.unpack_from(f"<{ndim}q", blob, off)
+            off += 8 * ndim
+            nbytes, crc = struct.unpack_from("<QI", blob, off)
+            off += 12
+            payload = blob[off:off + nbytes]
+            off += nbytes
+            if len(payload) != nbytes or zlib.crc32(payload) != crc:
+                raise ValueError(
+                    f"rebalance wire: CRC/length mismatch for {name!r} "
+                    f"span [{g0},{g1})")
+            blocks[name] = np.frombuffer(payload, dtype).reshape(shape)
+        out[(g0, g1)] = blocks
+    return out
+
+
+# ----------------------------------------------------------------------
 # applying a plan: row-block exchange over the hardened collectives
 # ----------------------------------------------------------------------
 def _subtract(a: Tuple[int, int], b: Tuple[int, int]
@@ -208,19 +326,19 @@ def _subtract(a: Tuple[int, int], b: Tuple[int, int]
 
 
 def exchange_rows(old_plan: ShardPlan, new_plan: ShardPlan, rank: int,
-                  row_blocks: Dict[str, Tuple[np.ndarray, int]]
-                  ) -> Dict[str, np.ndarray]:
+                  row_blocks: Dict[str, Tuple[np.ndarray, int]],
+                  comm=None) -> Dict[str, np.ndarray]:
     """Move rows between ranks so every rank ends up owning its
     ``new_plan`` range.  ``row_blocks`` maps name -> (array, row_axis)
     holding the rank's CURRENT rows in global row order.  Returns the
     new local arrays, rows in global order.
 
     Each rank broadcasts only the row blocks LEAVING it (allgather over
-    parallel/collect.py, tagged ``purpose="rebalance"`` in the comms
+    parallel/collect.py, or ``comm`` when the caller runs on a live
+    membership fleet; tagged ``purpose="rebalance"`` in the comms
     ledger); receivers take the pieces intersecting their new range.
-    Retained rows never leave the rank."""
-    from .collect import allgather_bytes
-
+    Retained rows never leave the rank.  The wire is framed raw-numpy
+    bytes (:func:`_pack_row_wire`), never pickle."""
     if old_plan.total != new_plan.total or old_plan.world != new_plan.world:
         raise ValueError(
             f"plan mismatch: {old_plan.counts} -> {new_plan.counts}")
@@ -239,10 +357,13 @@ def exchange_rows(old_plan: ShardPlan, new_plan: ShardPlan, rank: int,
             name: _take(np.asarray(arr), axis, g0 - old_s, g1 - old_s)
             for name, (arr, axis) in row_blocks.items()
         }
-    gathered = allgather_bytes(
-        pickle.dumps(outgoing, protocol=pickle.HIGHEST_PROTOCOL),
-        purpose="rebalance",
-    )
+    wire = _pack_row_wire(outgoing)
+    if comm is not None:
+        gathered = comm.allgather(wire, purpose="rebalance")
+    else:
+        from .collect import allgather_bytes
+
+        gathered = allgather_bytes(wire, purpose="rebalance")
 
     n_new = new_e - new_s
     out: Dict[str, np.ndarray] = {}
@@ -260,7 +381,7 @@ def exchange_rows(old_plan: ShardPlan, new_plan: ShardPlan, rank: int,
         out[name] = dst
     filled = max(0, min(old_e, new_e) - max(old_s, new_s))
     for blob in gathered:
-        for (g0, g1), blocks in pickle.loads(blob).items():
+        for (g0, g1), blocks in _unpack_row_wire(blob).items():
             lo, hi = max(g0, new_s), min(g1, new_e)
             if lo >= hi:
                 continue

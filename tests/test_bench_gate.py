@@ -468,3 +468,50 @@ def test_multimodel_admission_gate(tmp_path):
            "multimodel": {"error": "RuntimeError: boom",
                           "admission_refusal_ok": False}}
     assert bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={}) == 0
+
+
+# ----------------------------------------------------------------------
+# spot-economics leg
+# ----------------------------------------------------------------------
+def _spot(ratio=0.4, zero_lost=True):
+    return {"rows": 600, "trees": 16, "members": 2,
+            "cost_ratio_spot_vs_static": ratio,
+            "zero_lost_iterations": zero_lost}
+
+
+def test_spot_gate_fires_on_lost_iterations(tmp_path):
+    """Losing a completed iteration to churn voids the elastic premise:
+    the leg gates OUTRIGHT, priors or not, fallback or not."""
+    out = {"metric": METRIC, "value": 0.10, "backend_fallback": True,
+           "spot": _spot(zero_lost=False)}
+    rc = bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={})
+    assert rc == 1
+    assert out["regression_spot_lost_iterations"] is True
+    assert out["gate_spot"]["require_zero_lost_iterations"] is True
+
+
+def test_spot_gate_fires_on_cost_above_static(tmp_path):
+    out = {"metric": METRIC, "value": 0.10, "spot": _spot(ratio=0.95)}
+    rc = bench.apply_regression_gate(out, bench_dir=str(tmp_path), env={})
+    assert rc == 1
+    assert out["regression_spot_cost"] is True
+    assert out["gate_spot"]["max_cost_ratio_spot_vs_static"] == 0.8
+
+
+def test_spot_gate_passes_on_cheap_clean_run(tmp_path):
+    out = {"metric": METRIC, "value": 0.10, "spot": _spot()}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path),
+                                       env={}) == 0
+    assert "regression_spot_cost" not in out
+    assert "regression_spot_lost_iterations" not in out
+    assert out["gate_spot"]["cost_ratio_spot_vs_static"] == 0.4
+
+
+def test_spot_section_error_never_gates(tmp_path):
+    out = {"metric": METRIC, "value": 0.10,
+           "spot": {"error": "RuntimeError: boom",
+                    "zero_lost_iterations": False,
+                    "cost_ratio_spot_vs_static": 9.9}}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path),
+                                       env={}) == 0
+    assert "gate_spot" not in out

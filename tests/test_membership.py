@@ -1,0 +1,337 @@
+"""Live elastic membership unit tests (parallel/membership.py plus the
+boosting/gbdt.py membership seams — docs/ROBUSTNESS.md "Live elastic
+membership").
+
+In-process coverage: the FileKVClient store (write-once, framed,
+crash-safe tmp+link publish), the sparse-id MemberWatch, the
+three-runtime sync/commit protocol including deterministic coordinator
+re-election, and the epoch-scoped uid seams.  With
+``elastic_membership`` off (the default) nothing here is reachable and
+the pre-existing bounded fail-fast semantics hold (test_net_fault.py
+pins those).
+
+The subprocess-fleet acceptance runs (SIGTERM leave + SIGKILL evict +
+join in one run with byte-identity, coordinator-kill re-election, and
+the ``slow`` churn matrix) live in tests/test_zmembership_fleet.py —
+named to sort last so the expensive fleets run after the cheap suites.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.membership
+
+
+# ----------------------------------------------------------------------
+# FileKVClient (the externalized coordination store)
+# ----------------------------------------------------------------------
+def test_filekv_roundtrip_and_encoding(tmp_path):
+    from lightgbm_tpu.parallel.membership import FileKVClient
+
+    kv = FileKVClient(str(tmp_path / "kv"))
+    kv.key_value_set_bytes("a/b", b"\x00\x01binary\xff")
+    assert kv.blocking_key_value_get_bytes("a/b", 500) == b"\x00\x01binary\xff"
+    kv.key_value_set("plain", "text")
+    assert kv.blocking_key_value_get("plain", 500) == "text"
+    # tiny and empty values survive (the jaxlib client segfaults <2B —
+    # the file store must not inherit that trap)
+    kv.key_value_set_bytes("tiny", b"x")
+    assert kv.blocking_key_value_get_bytes("tiny", 500) == b"x"
+    kv.key_value_set_bytes("empty", b"")
+    assert kv.blocking_key_value_get_bytes("empty", 500) == b""
+    # hostile key components are percent-encoded per path segment
+    kv.key_value_set_bytes("we ird/%41/..", b"v")
+    assert kv.blocking_key_value_get_bytes("we ird/%41/..", 500) == b"v"
+
+
+def test_filekv_blocking_get_times_out(tmp_path):
+    from lightgbm_tpu.parallel.membership import FileKVClient
+
+    kv = FileKVClient(str(tmp_path / "kv"))
+    t0 = time.monotonic()
+    with pytest.raises(Exception, match="DEADLINE_EXCEEDED"):
+        kv.blocking_key_value_get_bytes("never", 200)
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_filekv_try_create_is_exclusive(tmp_path):
+    from lightgbm_tpu.parallel.membership import FileKVClient
+
+    kv = FileKVClient(str(tmp_path / "kv"))
+    assert kv.try_create("members/0", b"1") is True
+    assert kv.try_create("members/0", b"2") is False
+    assert kv.blocking_key_value_get_bytes("members/0", 500) == b"1"
+
+
+def test_filekv_dir_get_and_prefix_delete(tmp_path):
+    from lightgbm_tpu.parallel.membership import FileKVClient
+
+    kv = FileKVClient(str(tmp_path / "kv"))
+    for i in range(3):
+        kv.key_value_set_bytes(f"hb/7/{i}", str(i).encode())
+    kv.key_value_set_bytes("hb/9/0", b"0")
+    got = {k for k, _v in kv.key_value_dir_get("hb/")}
+    assert got == {"hb/7/0", "hb/7/1", "hb/7/2", "hb/9/0"}
+    kv.key_value_delete("hb/7/")
+    got = {k for k, _v in kv.key_value_dir_get("hb/")}
+    assert got == {"hb/9/0"}
+    kv.key_value_delete("hb/9/0")
+    assert kv.key_value_dir_get("hb/") == []
+
+
+# ----------------------------------------------------------------------
+# MemberWatch (sparse ids after churn)
+# ----------------------------------------------------------------------
+def test_memberwatch_sparse_ids_and_eviction(tmp_path):
+    from lightgbm_tpu.parallel import net
+    from lightgbm_tpu.parallel.membership import FileKVClient, MemberWatch
+
+    kv = FileKVClient(str(tmp_path / "kv"))
+    clock = [0.0]
+    watch = MemberWatch(kv, member_id=0, members=(0, 3, 7),
+                        stale_after_s=10.0, time_fn=lambda: clock[0])
+    kv.key_value_set(f"{net._HB_DIR}3/1", "1")
+    kv.key_value_set(f"{net._HB_DIR}7/1", "1")
+    assert watch.dead_ranks() == []
+    # member 7 freezes; member 3 keeps rotating its beat
+    clock[0] = 8.0
+    kv.key_value_set(f"{net._HB_DIR}3/2", "2")
+    kv.key_value_delete(f"{net._HB_DIR}3/1")
+    assert watch.dead_ranks() == []
+    clock[0] = 15.0  # 7 has been frozen 15s; 3 beat 7s ago
+    assert watch.dead_ranks() == [7]
+    # epoch transition evicts 7 from the roster: bookkeeping follows
+    watch.set_members((0, 3, 9))
+    kv.key_value_set(f"{net._HB_DIR}9/1", "1")
+    assert watch.dead_ranks() == []
+
+
+# ----------------------------------------------------------------------
+# sync / commit protocol (in-process, three runtimes, real store)
+# ----------------------------------------------------------------------
+def _bootstrap_trio(tmp_path):
+    from lightgbm_tpu.parallel.membership import MembershipRuntime
+
+    rts = [MembershipRuntime(str(tmp_path), m) for m in range(3)]
+    threads = [threading.Thread(target=rt.bootstrap,
+                                args=(3, (200, 200, 200))) for rt in rts]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive()
+    return rts
+
+
+def test_sync_no_churn_returns_none(tmp_path):
+    rts = _bootstrap_trio(tmp_path)
+    try:
+        out = [None] * 3
+        ts = [threading.Thread(target=lambda i=i: out.__setitem__(
+            i, rts[i].sync())) for i in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+            assert not t.is_alive()
+        assert out == [None, None, None]
+        assert [rt.epoch for rt in rts] == [0, 0, 0]
+    finally:
+        for rt in rts:
+            rt.stop()
+
+
+def test_sync_leave_join_and_commit(tmp_path):
+    """Member 1 requests a clean leave while a joiner posts intent: every
+    participant derives the identical decision and the commit moves the
+    fleet to epoch 1 with the re-derived roster."""
+    from lightgbm_tpu.parallel.membership import MembershipRuntime
+
+    rts = _bootstrap_trio(tmp_path)
+    joiner = MembershipRuntime(str(tmp_path))
+    try:
+        rts[1].request_leave()
+        jt = threading.Thread(target=joiner.join, kwargs={"timeout_s": 60})
+        jt.start()
+        while not joiner.client.key_value_dir_get("intent/join/"):
+            time.sleep(0.01)
+        decisions = [None] * 3
+        ts = [threading.Thread(target=lambda i=i: decisions.__setitem__(
+            i, rts[i].sync())) for i in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+            assert not t.is_alive()
+        for d in decisions:
+            assert d is not None
+            assert d.leavers == (1,)
+            assert d.dead == ()
+            assert d.joiners == (3,)
+            assert d.participants == (0, 1, 2)
+            assert d.new_members == (0, 2, 3)
+            assert d.survivors == (0, 2)
+        for rt, d in zip(rts, decisions):
+            rt.commit_epoch(d, (200, 200, 200), iteration=4, num_data=600,
+                            handoff_bytes=b"handoff-bytes"
+                            if rt.id == min(d.new_members) else None)
+        jt.join(timeout=30)
+        assert not jt.is_alive()
+        assert joiner.joined_mid_run
+        assert joiner.id == 3 and joiner.epoch == 1
+        assert joiner.members == (0, 2, 3) and joiner.start_iter == 4
+        assert joiner.read_handoff() == b"handoff-bytes"
+        assert [rt.epoch for rt in rts] == [1, 1, 1]
+        # the join intent was consumed at commit
+        assert joiner.client.key_value_dir_get("intent/join/") == []
+    finally:
+        for rt in rts + [joiner]:
+            rt.stop()
+
+
+def test_sync_evicts_dead_and_reelects_coordinator(tmp_path):
+    """Member 0 (the coordinator) dies: survivors converge on the same
+    eviction decision and the NEW coordinator is the lowest surviving id
+    — re-election is by construction, not by vote."""
+    rts = _bootstrap_trio(tmp_path)
+    try:
+        rts[0].stop()  # heartbeat freezes — 0 is now "dead"
+        decisions = [None, None]
+        ts = [threading.Thread(target=lambda i=i: decisions.__setitem__(
+            i - 1, rts[i].sync(known_dead=(0,)))) for i in (1, 2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+            assert not t.is_alive()
+        for d in decisions:
+            assert d is not None
+            assert d.dead == (0,)
+            assert d.new_members == (1, 2)
+            assert d.participants == (1, 2)
+        for rt, d in zip(rts[1:], decisions):
+            rt.commit_epoch(d, (300, 300), iteration=2, num_data=600)
+        assert rts[1].is_coordinator and not rts[2].is_coordinator
+        assert rts[1].members == (1, 2) and rts[1].epoch == 1
+        assert rts[1].rank == 0 and rts[2].rank == 1
+    finally:
+        for rt in rts:
+            rt.stop()
+
+
+def test_member_ids_are_monotonic_never_reused(tmp_path):
+    from lightgbm_tpu.parallel.membership import MembershipRuntime
+
+    rts = _bootstrap_trio(tmp_path)
+    try:
+        j1 = MembershipRuntime(str(tmp_path))
+        j2 = MembershipRuntime(str(tmp_path))
+        # allocate ids without completing the join handshake
+        for j in (j1, j2):
+            i = 0
+            while not j.client.try_create(f"members/{i}", b"1"):
+                i += 1
+            j.id = i
+        assert (j1.id, j2.id) == (3, 4)
+    finally:
+        for rt in rts:
+            rt.stop()
+
+
+# ----------------------------------------------------------------------
+# training-path guards
+# ----------------------------------------------------------------------
+def test_membership_rejects_query_grouped_data(tmp_path):
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import LightGBMError
+    from lightgbm_tpu.parallel import membership
+    from lightgbm_tpu.parallel.membership import MembershipRuntime
+
+    rt = MembershipRuntime(str(tmp_path / "fleet"), 0)
+    rt.bootstrap(1, (120,))
+    membership.set_runtime(rt)
+    try:
+        rng = np.random.default_rng(5)
+        X = rng.integers(0, 5, size=(120, 4)).astype(np.float32)
+        y = rng.integers(0, 3, size=120).astype(np.float32)
+        p = dict(objective="lambdarank", tree_learner="data",
+                 pre_partition=True, elastic_membership=True,
+                 num_leaves=5, verbose=-1)
+        ds = lgb.Dataset(X, label=y, group=[30, 40, 50], params=dict(p))
+        with pytest.raises(LightGBMError, match="query"):
+            lgb.train(p, ds, num_boost_round=2)
+    finally:
+        membership.set_runtime(None)
+        rt.stop()
+
+
+# ----------------------------------------------------------------------
+# epoch-scoped uid seams (net.epoch_uid layout, collect.set_epoch,
+# comm.epoch, distributed.current_epoch)
+# ----------------------------------------------------------------------
+def test_epoch_uid_layout_roundtrip():
+    from lightgbm_tpu.parallel import net
+
+    ns = 1 << 58
+    uid = net.epoch_uid(7, (3 << 16) | 0xBEEF, ns=ns)
+    assert net.uid_epoch(uid) == 7
+    assert uid & 0xFFFF == 0xBEEF and uid & ns
+    assert net.uid_epoch(12345) == 0  # static-world uids: no epoch field
+    with pytest.raises(ValueError):
+        net.epoch_uid(1 << 30, 0)
+
+
+def test_collect_epoch_scoping_never_reuses_uids():
+    from lightgbm_tpu.parallel import collect, net
+
+    prev_epoch, prev_uid = collect._kv_epoch, collect._kv_uid
+    try:
+        collect.set_epoch(0)
+        a = net.epoch_uid(collect._kv_epoch, next(collect._kv_uid))
+        collect.set_epoch(3)
+        b = net.epoch_uid(collect._kv_epoch, next(collect._kv_uid))
+        assert net.uid_epoch(b) == 3 and b != a
+        # re-announcing the SAME epoch must not restart the sequence
+        seq_before = next(collect._kv_uid)
+        collect.set_epoch(3)
+        assert next(collect._kv_uid) == seq_before + 1
+    finally:
+        collect._kv_epoch, collect._kv_uid = prev_epoch, prev_uid
+
+
+def test_comm_epoch_surface(tmp_path):
+    from lightgbm_tpu.parallel.comm import Comm, LocalComm, LocalGroup
+    from lightgbm_tpu.parallel.distributed import current_epoch
+    from lightgbm_tpu.parallel.membership import (MembershipComm,
+                                                  MembershipRuntime,
+                                                  runtime, set_runtime)
+
+    assert Comm.epoch == 0
+    # static comms never bump it
+    assert LocalComm(0, LocalGroup(2)).epoch == 0
+    rt = MembershipRuntime(str(tmp_path), 0)
+    try:
+        threading.Thread(target=rt.bootstrap, args=(1, (10,))).start()
+        deadline = time.monotonic() + 30
+        while rt.epoch < 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert MembershipComm(rt).epoch == rt.epoch == 0
+        prev = runtime()
+        try:
+            set_runtime(rt)
+            assert current_epoch() == 0
+        finally:
+            set_runtime(prev)
+    finally:
+        rt.stop()
+
+
+def test_current_epoch_is_zero_when_unarmed():
+    from lightgbm_tpu.parallel.distributed import current_epoch
+    from lightgbm_tpu.parallel.membership import runtime
+
+    if runtime() is None:
+        assert current_epoch() == 0

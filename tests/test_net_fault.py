@@ -353,3 +353,60 @@ def test_coordinator_death_is_bounded_not_a_hang(tmp_path):
             logs[1][-2000:]
     # the whole point: no indefinite hang on a dead coordinator
     assert wall <= DETECT_BOUND + 30.0
+
+
+# ----------------------------------------------------------------------
+# elastic counterpart (ISSUE 19): armed membership re-elects, the
+# default keeps every fail-fast contract above byte-for-byte
+# ----------------------------------------------------------------------
+@pytest.mark.netfault
+@pytest.mark.membership
+def test_membership_armed_reelects_deterministically(tmp_path):
+    """The elastic counterpart to
+    test_coordinator_death_is_bounded_not_a_hang: with a membership
+    runtime armed, the coordinator's death is NOT a job-fatal transport
+    error.  The survivors converge on the identical eviction decision
+    and the new coordinator is DETERMINISTIC — the lowest surviving
+    member id, by construction rather than by vote — so any two runs of
+    the same churn re-elect the same member.  The default
+    (``elastic_membership=false``, every other test in this file) keeps
+    the bounded fail-fast semantics unchanged."""
+    import threading
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.parallel.membership import MembershipRuntime
+
+    # the knob defaults OFF: nothing in this file runs elastic code
+    assert Config().elastic_membership is False
+
+    rts = [MembershipRuntime(str(tmp_path), m) for m in range(3)]
+    threads = [threading.Thread(target=rt.bootstrap,
+                                args=(3, (200, 200, 200))) for rt in rts]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive()
+    try:
+        rts[0].stop()  # the coordinator freezes — SIGKILL equivalent
+        decisions = [None, None]
+        ts = [threading.Thread(target=lambda i=i: decisions.__setitem__(
+            i - 1, rts[i].sync(known_dead=(0,)))) for i in (1, 2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+            assert not t.is_alive()
+        # both survivors derived the IDENTICAL decision independently
+        for d in decisions:
+            assert d is not None
+            assert d.dead == (0,) and d.new_members == (1, 2)
+        for rt, d in zip(rts[1:], decisions):
+            rt.commit_epoch(d, (300, 300), iteration=3, num_data=600)
+        # re-election is positional: lowest surviving id — member 1
+        assert rts[1].is_coordinator and not rts[2].is_coordinator
+        assert min(rts[1].members) == 1
+        assert rts[1].rank == 0 and rts[2].rank == 1
+    finally:
+        for rt in rts[1:]:
+            rt.stop()

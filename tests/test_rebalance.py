@@ -227,3 +227,174 @@ def test_rebalance_moves_rows_off_injected_straggler(tmp_path):
     assert reb[0]["wait_share_before"] is not None, reb
     rendered = report.render_merge(m)
     assert "rebalance" in rendered and "->" in rendered, rendered
+
+
+# ----------------------------------------------------------------------
+# row-block wire (framed raw-numpy bytes, no pickle — docs/ROBUSTNESS.md)
+# ----------------------------------------------------------------------
+def _wire_example():
+    from lightgbm_tpu.parallel.shardplan import _pack_row_wire
+    out = {
+        (5, 9): {"bins": np.arange(8, dtype=np.int8).reshape(4, 2),
+                 "label": np.array([0.0, 1.0, 1.0, 0.0], np.float32)},
+        (20, 22): {"bins": np.array([[7, 7]], np.int8).repeat(2, 0),
+                   "label": np.array([1.0, 0.5], np.float32)},
+    }
+    return out, _pack_row_wire(out)
+
+
+# the exact frame for _wire_example(): magic, little-endian headers,
+# sorted spans/names, C-order payloads, CRC32 per array.  Pinned so wire
+# compatibility breaks loudly (mixed-version fleets exchange this blob).
+_WIRE_PIN = (
+    "5242310002000000050000000000000009000000000000000200000004000300"
+    "000262696e737c69310400000000000000020000000000000008000000000000"
+    "009f68aa8800010203040506070500030000016c6162656c3c66340400000000"
+    "0000001000000000000000d876f7c6000000000000803f0000803f0000000014"
+    "0000000000000016000000000000000200000004000300000262696e737c6931"
+    "02000000000000000200000000000000040000000000000044f2f96807070707"
+    "0500030000016c6162656c3c663402000000000000000800000000000000dbc9"
+    "85ee0000803f0000003f"
+)
+
+
+def test_row_wire_pins_exact_bytes():
+    _out, blob = _wire_example()
+    assert blob.hex() == _WIRE_PIN.replace("\n", "")
+
+
+def test_row_wire_roundtrip_exact():
+    from lightgbm_tpu.parallel.shardplan import _unpack_row_wire
+    out, blob = _wire_example()
+    back = _unpack_row_wire(blob)
+    assert set(back) == set(out)
+    for span, blocks in out.items():
+        assert set(back[span]) == set(blocks)
+        for name, arr in blocks.items():
+            got = back[span][name]
+            assert got.dtype == arr.dtype and got.shape == arr.shape
+            assert got.tobytes() == arr.tobytes()
+
+
+def test_row_wire_rejects_corruption():
+    from lightgbm_tpu.parallel.shardplan import _unpack_row_wire
+    _out, blob = _wire_example()
+    with pytest.raises(ValueError, match="bad magic"):
+        _unpack_row_wire(b"XX" + blob[2:])
+    flipped = bytearray(blob)
+    flipped[-1] ^= 0xFF  # corrupt the last payload byte
+    with pytest.raises(ValueError, match="CRC"):
+        _unpack_row_wire(bytes(flipped))
+    with pytest.raises(ValueError, match="CRC|length"):
+        _unpack_row_wire(blob[:-1])  # truncated mid-payload
+
+
+# ----------------------------------------------------------------------
+# query-group boundary snapping (whole-group moves for lambdarank)
+# ----------------------------------------------------------------------
+def test_snap_to_groups_basic():
+    from lightgbm_tpu.parallel.shardplan import snap_to_groups
+    gb = np.array([0, 10, 30, 60, 100], np.int64)
+    # each ideal cut snaps to the nearest group boundary
+    assert snap_to_groups([28], gb) == (30,)
+    assert snap_to_groups([45, 80], gb) == (30, 60)
+    # ties break toward the lower boundary
+    assert snap_to_groups([20], gb) == (10,)
+
+
+def test_snap_to_groups_collision_pushes_forward():
+    from lightgbm_tpu.parallel.shardplan import snap_to_groups
+    gb = np.array([0, 10, 30, 60, 100], np.int64)
+    # both ideals want 30; the second cut must move past it
+    assert snap_to_groups([29, 31], gb) == (30, 60)
+
+
+def test_snap_to_groups_returns_none_when_groups_run_out():
+    from lightgbm_tpu.parallel.shardplan import snap_to_groups
+    gb = np.array([0, 50, 100], np.int64)  # one interior boundary
+    assert snap_to_groups([40, 70], gb) is None  # 2 cuts, 1 boundary
+
+
+def test_controller_group_bounds_moves_whole_groups():
+    from lightgbm_tpu.parallel.shardplan import RebalanceController
+    gb = np.array([0, 40, 80, 130, 180, 256], np.int64)
+    ctl = RebalanceController(threshold=1.2, patience=1,
+                              max_move_frac=0.5, group_bounds=gb)
+    plan = ShardPlan.from_counts([128, 128])
+    newp = None
+    for _ in range(4):
+        newp = ctl.observe(plan, [3.0, 1.0]) or newp
+    assert newp is not None
+    # the cut lands exactly on a group boundary, never mid-group
+    assert newp.starts[1] in set(int(g) for g in gb)
+    assert newp.counts[0] < newp.counts[1]
+    assert sum(newp.counts) == 256
+
+
+# ----------------------------------------------------------------------
+# distributed lambdarank (group-aligned shards; whole-group rebalance)
+# ----------------------------------------------------------------------
+def _lambdarank_fleet(tmp_path, tag, world, extra_env=None):
+    out = str(tmp_path / tag)
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "LIGHTGBM_TPU_FAULT",
+                        "LIGHTGBM_TPU_FAULT_RANK", "LIGHTGBM_TPU_TRACE")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(ELASTIC_OBJECTIVE="lambdarank", ELASTIC_QUANTIZED="1",
+               ELASTIC_ROWS="512", ELASTIC_TREES="10", ELASTIC_FREQ="100",
+               ELASTIC_LEAVES="7")
+    env.update(extra_env or {})
+    procs = [subprocess.Popen(
+        [sys.executable, EWORKER, str(r), str(world), str(port), out,
+         "train", str(tmp_path / f"ck_{tag}")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=dict(env)) for r in range(world)]
+    logs = [p.communicate(timeout=420)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs), "\n".join(
+        l[-2500:] for l in logs)
+    res = [json.load(open(out + f".rank{r}.json")) for r in range(world)]
+    models = [open(out + f".rank{r}.txt").read() for r in range(world)]
+    return res, models
+
+
+def test_lambdarank_two_rank_parity(tmp_path):
+    """First distributed lambdarank coverage: data-parallel ranks hold
+    whole query groups and train in lockstep; quantized integer
+    histograms make the result byte-identical ACROSS world sizes (the
+    same world-invariance the binary oocdist tests pin — serial-vs-
+    distributed stays structural parity per test_multihost.py)."""
+    res2, models2 = _lambdarank_fleet(tmp_path, "w2", 2)
+    res4, models4 = _lambdarank_fleet(tmp_path, "w4", 4)
+    assert res2[0]["trees"] == res4[0]["trees"] == 10
+    # no query group is split: the shard group counts add up to the
+    # global group count at every world
+    n2 = sum(r["n_local_groups"] for r in res2)
+    n4 = sum(r["n_local_groups"] for r in res4)
+    assert n2 == n4 > 4
+    assert all(r["n_local_groups"] > 0 for r in res2 + res4)
+    assert models2[0] == models2[1], "data-parallel ranks diverged"
+    assert len(set(models4)) == 1, "world-4 ranks diverged"
+    assert models2[0] == models4[0], \
+        "lambdarank bytes changed with world size"
+
+
+def test_lambdarank_rebalance_moves_whole_groups(tmp_path):
+    """Rebalance leg: rank 0 is an injected straggler; the controller
+    must move load at QUERY-GROUP granularity — every shard edge of the
+    final plan is a group boundary and no group spans ranks."""
+    res, models = _lambdarank_fleet(
+        tmp_path, "rb", 2,
+        {"ELASTIC_REBALANCE": "1", "ELASTIC_TREES": "12",
+         "LIGHTGBM_TPU_FAULT": "delay:40:after:5",
+         "LIGHTGBM_TPU_FAULT_RANK": "0"})
+    counts = res[0]["final_counts"]
+    assert counts == res[1]["final_counts"], res
+    assert counts is not None and sum(counts) == 512, res
+    assert counts[0] < counts[1], "rows did not move off the straggler"
+    # whole-group invariant, asserted by each rank against the global
+    # cumulative group boundaries
+    assert res[0]["group_aligned"] is True, res
+    assert res[1]["group_aligned"] is True, res
+    assert res[0]["rows_end"] == counts[0] and res[1]["rows_end"] == counts[1]
+    assert models[0] == models[1], "ranks diverged after group rebalance"

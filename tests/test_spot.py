@@ -1,0 +1,178 @@
+"""Preemptible-capacity economics loop (factory/spot.py,
+docs/FACTORY.md "spot").
+
+Unit tests pin the schedule grammar (scripted + seeded traces, both
+replayable), the atomic cost-ledger document and its
+zero-lost-iterations proof; the e2e leg drives a REAL 2-member elastic
+fleet (tests/membership_worker.py) through a preempt-then-respawn
+trace and checks the survivors' model, the priced ledger, and the
+write-once per-iteration records."""
+
+import json
+import os
+
+import pytest
+
+from lightgbm_tpu.factory.spot import (ON_DEMAND_PRICE, CostLedger,
+                                       SpotEvent, SpotFleet, SpotSchedule,
+                                       run_static_baseline)
+
+pytestmark = pytest.mark.membership
+
+
+# ----------------------------------------------------------------------
+# schedule
+# ----------------------------------------------------------------------
+def test_schedule_script_grammar():
+    s = SpotSchedule.from_script(
+        "preempt@2.5;spawn@4;price@6=0.5;preempt@8=1", base_price=0.3)
+    assert [e.kind for e in s.events] == ["preempt", "spawn", "price",
+                                         "preempt"]
+    assert s.events[3].target == 1 and s.events[0].target is None
+    assert s.price_at(0.0) == 0.3          # base before the first step
+    assert s.price_at(7.0) == 0.5          # stepped
+    assert [e.kind for e in s.due(2.0, 4.0)] == ["preempt", "spawn"]
+    assert s.due(4.0, 4.0) == []           # window is half-open
+
+
+@pytest.mark.parametrize("bad", ["preempt", "frob@3", "spawn@4=1",
+                                 "price@", "price@3"])
+def test_schedule_rejects_bad_tokens(bad):
+    with pytest.raises(ValueError):
+        SpotSchedule.from_script(bad)
+
+
+def test_schedule_sample_is_seed_deterministic():
+    a = SpotSchedule.sample(11, 60.0)
+    b = SpotSchedule.sample(11, 60.0)
+    c = SpotSchedule.sample(12, 60.0)
+    key = lambda s: [(e.t_s, e.kind, e.value) for e in s.events]  # noqa: E731
+    assert key(a) == key(b)
+    assert key(a) != key(c)
+    # prices stay inside (0, on-demand]: spot never costs MORE than
+    # the capacity it undercuts
+    for ev in a.events:
+        if ev.kind == "price":
+            assert 0.0 < ev.value <= ON_DEMAND_PRICE
+
+
+def test_schedule_rejects_unknown_kind():
+    with pytest.raises(ValueError):
+        SpotSchedule([SpotEvent(1.0, "evaporate")])
+
+
+# ----------------------------------------------------------------------
+# ledger
+# ----------------------------------------------------------------------
+def test_ledger_roundtrip_and_cost_math(tmp_path):
+    path = str(tmp_path / "ledger.json")
+    led = CostLedger(path)
+    led.charge(0, 10.0, 0.3)
+    led.charge(0, 2.0, 0.5)
+    led.charge("join1", 4.0, 0.5)
+    led.event(3.0, "preempt", member="1")
+    for it in range(6):
+        led.iteration(it, epoch=it // 3, t_s=it * 0.5)
+    led.finish(trees=6)
+    led.flush()
+    back = CostLedger.load(path)
+    assert back.total_cost == pytest.approx(10 * 0.3 + 2 * 0.5 + 4 * 0.5)
+    assert back.cost_per_model() == pytest.approx(back.total_cost)
+    assert back.zero_lost_iterations()
+    doc = json.load(open(path))
+    assert doc["version"] == CostLedger.VERSION
+    assert doc["member_seconds"]["0"] == pytest.approx(12.0)
+    assert doc["events"][0]["kind"] == "preempt"
+
+
+def test_ledger_flush_is_atomic(tmp_path):
+    path = str(tmp_path / "ledger.json")
+    led = CostLedger(path)
+    led.charge(0, 1.0, 1.0)
+    led.flush()
+    # a later torn write may never clobber the published document: the
+    # tmp file is a sibling, the publish is os.replace
+    led.charge(0, 1.0, 1.0)
+    led.flush()
+    assert not os.path.exists(path + ".tmp")
+    assert CostLedger.load(path).total_cost == pytest.approx(2.0)
+
+
+def test_ledger_detects_lost_and_incomplete(tmp_path):
+    led = CostLedger(str(tmp_path / "l.json"))
+    led.iteration(0, 0, 0.0)
+    led.iteration(2, 0, 1.0)  # iteration 1 never completed anywhere
+    assert not led.zero_lost_iterations()   # not finished
+    assert led.cost_per_model() is None
+    led.finish(3)
+    assert not led.zero_lost_iterations()   # gap
+    good = CostLedger(str(tmp_path / "g.json"))
+    for it in range(3):
+        good.iteration(it, 0, 0.0)
+    good.finish(3)
+    assert good.zero_lost_iterations()
+
+
+def test_ledger_iteration_records_are_write_once(tmp_path):
+    led = CostLedger(str(tmp_path / "l.json"))
+    led.iteration(0, epoch=0, t_s=1.0)
+    led.iteration(0, epoch=9, t_s=9.0)  # a redo cannot re-claim the slot
+    assert led._doc["iterations"]["0"]["epoch"] == 0
+
+
+def test_ledger_version_mismatch_is_loud(tmp_path):
+    path = str(tmp_path / "l.json")
+    with open(path, "w") as fh:
+        json.dump({"version": 99}, fh)
+    with pytest.raises(ValueError, match="version"):
+        CostLedger.load(path)
+
+
+# ----------------------------------------------------------------------
+# e2e fleet
+# ----------------------------------------------------------------------
+def test_spot_fleet_preempt_respawn_e2e(tmp_path):
+    """2-member fleet, member 1 preempted at t=3, replacement capacity
+    at t=4: the fleet must complete the model, the ledger must price
+    every member-second at the spot price, and the write-once iteration
+    records must prove nothing was redone."""
+    fleet_dir = str(tmp_path / "fleet")
+    ledger_path = str(tmp_path / "ledger.json")
+    fleet = SpotFleet(fleet_dir, SpotSchedule.from_script(
+        "preempt@3=1;spawn@4", base_price=0.25), 2, ledger_path,
+        trees=10, rows=600,
+        extra_env={"MEMBER_ITER_SLEEP": "0.5"})
+    summary = fleet.run(timeout_s=180)
+    assert summary["cost"] is not None, summary["exits"]
+    assert summary["zero_lost_iterations"], summary
+    assert summary["models"], "no finisher wrote a model"
+    # every finisher converged on the same bytes
+    assert len(set(summary["models"].values())) == 1
+    # the preempted bootstrap member died by SIGKILL and left no model
+    assert summary["exits"]["1"] == -9
+    assert "1" not in summary["models"]
+    led = CostLedger.load(ledger_path)
+    assert led.total_cost == pytest.approx(summary["cost"])
+    kinds = [e["kind"] for e in led._doc["events"]]
+    assert "preempt" in kinds and "spawn" in kinds
+    # the ledger priced at spot, not on-demand: total member-seconds x
+    # base price bounds the document's spend
+    secs = sum(led._doc["member_seconds"].values())
+    assert led.total_cost == pytest.approx(secs * 0.25, rel=1e-6)
+
+
+def test_static_baseline_prices_on_demand(tmp_path):
+    summary = run_static_baseline(
+        str(tmp_path / "fleet"), 2, str(tmp_path / "ledger.json"),
+        trees=6, rows=600, extra_env={"MEMBER_ITER_SLEEP": "0"})
+    assert summary["cost"] is not None, summary["exits"]
+    assert summary["zero_lost_iterations"]
+    led = CostLedger.load(str(tmp_path / "ledger.json"))
+    secs = sum(led._doc["member_seconds"].values())
+    assert led.total_cost == pytest.approx(secs * ON_DEMAND_PRICE, rel=1e-6)
+
+
+def test_spot_cli_needs_fleet_dir(capsys):
+    from lightgbm_tpu.factory.spot import main
+
+    assert main([]) == 2  # EXIT_BAD_ARGS

@@ -1,0 +1,284 @@
+"""Subprocess-fleet acceptance tests for live elastic membership.
+
+These spawn REAL worker fleets (tests/membership_worker.py — each
+member a single-process JAX subprocess coordinating through a shared
+fleet directory) and are by far the most expensive tests in the suite;
+the file is named to sort LAST so the cheap broad suites run first.
+The in-process membership unit tests live in tests/test_membership.py.
+
+The acceptance contract: a real 3-worker fleet survives, in ONE run, a
+SIGTERM clean leave, a SIGKILL eviction, and a mid-run join — and
+(quantized mode, integer features) the survivors' final model is
+BYTE-IDENTICAL to the static single-worker reference trained on the
+same global data.  Killing the coordinator (member 0) re-elects the
+lowest surviving id and the fleet still completes with the identical
+model.  The full churn matrix is marked ``slow``.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "membership_worker.py")
+
+pytestmark = pytest.mark.membership
+
+_STRIP = ("LIGHTGBM_TPU_", "MEMBER_", "XLA_")
+
+
+def _clean_env(extra=None):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(_STRIP)}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["LIGHTGBM_TPU_NET_TIMEOUT"] = "8"
+    if extra:
+        env.update(extra)
+    return env
+
+
+def _spawn(member, fleet_dir, out, extra_env=None):
+    return subprocess.Popen(
+        [sys.executable, WORKER, str(member), fleet_dir, out],
+        env=_clean_env(extra_env), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+
+
+def _run_fleet(fleet_dir, nproc, per_member=None, with_joiner=False,
+               timeout=240):
+    """Launch a bootstrap fleet (plus optionally one mid-run joiner) and
+    wait for every process; returns {member_key: (rc, stdout)}."""
+    os.makedirs(fleet_dir, exist_ok=True)
+    out = os.path.join(fleet_dir, "out")
+    procs = {}
+    for m in range(nproc):
+        extra = {"MEMBER_NPROC": str(nproc)}
+        extra.update((per_member or {}).get(m, {}))
+        procs[m] = _spawn(m, fleet_dir, out, extra)
+    if with_joiner:
+        procs["join"] = _spawn("join", fleet_dir, out,
+                               {"MEMBER_NPROC": str(nproc)})
+    deadline = time.monotonic() + timeout
+    results = {}
+    for key, p in procs.items():
+        try:
+            o, _ = p.communicate(timeout=max(1, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            o, _ = p.communicate()
+            o = (o or "") + "\n<<parent timeout — killed>>"
+        results[key] = (p.returncode, o or "")
+    return out, results
+
+
+def _meta(out, mid):
+    with open(out + f".m{mid}.json") as fh:
+        return json.load(fh)
+
+
+def _model(out, mid):
+    with open(out + f".m{mid}.txt") as fh:
+        return fh.read()
+
+
+def _dump(results):
+    return "\n".join(f"--- member {k} rc={rc} ---\n{o[-2500:]}"
+                     for k, (rc, o) in results.items())
+
+
+@pytest.fixture(scope="module")
+def reference(tmp_path_factory):
+    """The static reference: ONE member holding the whole global
+    dataset.  Quantized training's seed chain depends only on the
+    iteration index, so any static world — and any elastic trajectory
+    that preserves state exactly — must reproduce these bytes."""
+    d = str(tmp_path_factory.mktemp("member_ref"))
+    out, results = _run_fleet(d, 1)
+    rc, _o = results[0]
+    assert rc == 0, _dump(results)
+    return _model(out, 0), _meta(out, 0)
+
+
+# ----------------------------------------------------------------------
+# default-off guard (the pre-PR path must be bit-for-bit untouched)
+# ----------------------------------------------------------------------
+def test_elastic_off_and_armed_without_runtime_are_identical():
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.config import Config
+
+    assert Config().elastic_membership is False
+
+    rng = np.random.default_rng(3)
+    X = rng.integers(0, 5, size=(160, 6)).astype(np.float32)
+    y = (rng.random(160) < 0.5).astype(np.float32)
+    base = dict(objective="binary", num_leaves=5, learning_rate=0.2,
+                max_bin=31, min_data_in_leaf=10, seed=11, verbose=-1,
+                num_boost_round=4)
+
+    def _train(extra):
+        p = dict(base, **extra)
+        ds = lgb.Dataset(X, label=y, params=dict(p))
+        return lgb.train(p, ds, num_boost_round=4).model_to_string()
+
+    # armed but no fleet runtime registered -> warning-decline to the
+    # exact same path as unarmed (tree_learner held fixed: the knob
+    # itself must change nothing)
+    ref = _train({"tree_learner": "data", "pre_partition": True})
+    armed = _train({"elastic_membership": True, "tree_learner": "data",
+                    "pre_partition": True})
+    assert armed == ref
+    # the knob is fingerprint-volatile: flipping it must not invalidate
+    # checkpoints (ckpt/state.py _FP_VOLATILE)
+    from lightgbm_tpu.ckpt.state import config_fingerprint
+    assert (config_fingerprint(Config(**{k: v for k, v in base.items()
+                                         if k != "num_boost_round"}))
+            == config_fingerprint(Config(elastic_membership=True,
+                                         **{k: v for k, v in base.items()
+                                            if k != "num_boost_round"})))
+
+
+# ----------------------------------------------------------------------
+# subprocess fleets (tier-1 acceptance)
+# ----------------------------------------------------------------------
+def test_fleet_churn_one_run_byte_identity(reference, tmp_path):
+    """THE acceptance run: 3 bootstrap workers; member 1 SIGTERMs itself
+    at iteration 2 (real signal -> handler -> clean leave), member 2 is
+    SIGKILLed at iteration 5 (eviction), and a joiner arrives mid-run.
+    The two finishers must produce the reference bytes."""
+    ref_model, _ref_meta = reference
+    per = {
+        0: {"MEMBER_ITER_SLEEP": "0.4"},  # paces the lockstep fleet so
+        #                                   the joiner lands mid-run
+        1: {"MEMBER_SIGTERM_ITER": "2"},
+        2: {"MEMBER_KILL_ITER": "5"},
+    }
+    out, results = _run_fleet(str(tmp_path), 3, per_member=per,
+                              with_joiner=True)
+    assert results[0][0] == 0, _dump(results)
+    assert results[1][0] == 0, _dump(results)       # clean leave exits 0
+    assert results[2][0] == -signal.SIGKILL, _dump(results)
+    assert results["join"][0] == 0, _dump(results)
+
+    leaver = _meta(out, 1)
+    assert leaver["left_at_epoch"] >= 1
+    assert not os.path.exists(out + ".m1.txt")      # leavers write no model
+    joiner_id = max(int(f.split(".m")[1].split(".")[0])
+                    for f in os.listdir(str(tmp_path))
+                    if f.startswith("out.m") and f.endswith(".json"))
+    assert joiner_id >= 3                           # monotonic fresh id
+    for mid in (0, joiner_id):
+        meta = _meta(out, mid)
+        assert meta["trees"] == 12 and meta["iters"] == 12, meta
+        assert meta["final_members"] == sorted(meta["final_members"])
+        assert 1 not in meta["final_members"]
+        assert 2 not in meta["final_members"]
+        assert joiner_id in meta["final_members"]
+        assert sum(meta["final_counts"]) == 600
+        assert _model(out, mid) == ref_model, (
+            f"member {mid} diverged from the static reference")
+    # zero lost iterations: the survivor trained every round exactly once
+    assert len(_meta(out, 0)["epochs_seen"]) == 12
+
+
+def test_fleet_coordinator_sigkill_reelection(reference, tmp_path):
+    """Rank 0 IS the coordinator; SIGKILLing it mid-run must re-elect
+    member 1 (lowest survivor), bump the epoch, and still complete with
+    the reference bytes."""
+    ref_model, _ref_meta = reference
+    out, results = _run_fleet(str(tmp_path), 3,
+                              per_member={0: {"MEMBER_KILL_ITER": "4"}})
+    assert results[0][0] == -signal.SIGKILL, _dump(results)
+    for mid in (1, 2):
+        assert results[mid][0] == 0, _dump(results)
+        meta = _meta(out, mid)
+        assert meta["final_members"] == [1, 2]
+        assert meta["final_epoch"] >= 1
+        assert meta["trees"] == 12
+        assert _model(out, mid) == ref_model
+    # deterministic re-election: both survivors agree the new
+    # coordinator is the lowest surviving id
+    m1 = _meta(out, 1)
+    assert min(m1["final_members"]) == 1
+
+
+# ----------------------------------------------------------------------
+# churn matrix (slow)
+# ----------------------------------------------------------------------
+@pytest.mark.slow
+@pytest.mark.parametrize("scenario", ["float_churn", "rebalance_churn",
+                                      "double_join", "shrink_to_one"])
+def test_fleet_churn_matrix(scenario, tmp_path):
+    per = {}
+    nproc, with_joiner = 3, False
+    joiner_env = {}
+    expect_finishers = None
+    if scenario == "float_churn":
+        # non-quantized float mode: world-size parity is not byte-exact,
+        # so assert survival + roster, not bytes
+        per = {0: {"MEMBER_QUANTIZED": "0", "MEMBER_ITER_SLEEP": "0.4"},
+               1: {"MEMBER_QUANTIZED": "0", "MEMBER_SIGTERM_ITER": "2"},
+               2: {"MEMBER_QUANTIZED": "0", "MEMBER_KILL_ITER": "5"}}
+        joiner_env = {"MEMBER_QUANTIZED": "0"}
+        with_joiner = True
+    elif scenario == "rebalance_churn":
+        per = {m: {"MEMBER_REBALANCE": "1"} for m in range(3)}
+        per[1]["MEMBER_LEAVE_ITER"] = "3"
+        expect_finishers = [0, 2]
+    elif scenario == "double_join":
+        nproc = 2
+        per = {0: {"MEMBER_ITER_SLEEP": "0.5"}}
+        with_joiner = True  # plus a second joiner below
+    elif scenario == "shrink_to_one":
+        per = {1: {"MEMBER_LEAVE_ITER": "1"}, 2: {"MEMBER_LEAVE_ITER": "3"}}
+        expect_finishers = [0]
+
+    os.makedirs(str(tmp_path), exist_ok=True)
+    out = os.path.join(str(tmp_path), "out")
+    procs = {}
+    for m in range(nproc):
+        extra = {"MEMBER_NPROC": str(nproc)}
+        extra.update(per.get(m, {}))
+        procs[m] = _spawn(m, str(tmp_path), out, extra)
+    if with_joiner:
+        procs["join"] = _spawn("join", str(tmp_path), out,
+                               dict(joiner_env, MEMBER_NPROC=str(nproc)))
+    if scenario == "double_join":
+        time.sleep(2.0)
+        procs["join2"] = _spawn("join", str(tmp_path), out,
+                                dict(joiner_env, MEMBER_NPROC=str(nproc)))
+    results = {}
+    for key, p in procs.items():
+        try:
+            o, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            o, _ = p.communicate()
+        results[key] = (p.returncode, o or "")
+
+    finisher_models = {}
+    for f in os.listdir(str(tmp_path)):
+        if f.startswith("out.m") and f.endswith(".txt"):
+            mid = int(f.split(".m")[1].split(".")[0])
+            finisher_models[mid] = _model(out, mid)
+    assert finisher_models, _dump(results)
+    metas = {mid: _meta(out, mid) for mid in finisher_models}
+    rosters = {tuple(m["final_members"]) for m in metas.values()}
+    assert len(rosters) == 1, (rosters, _dump(results))
+    for mid, meta in metas.items():
+        assert meta["trees"] == 12, _dump(results)
+        assert sum(meta["final_counts"]) == 600
+    assert len(set(finisher_models.values())) == 1, _dump(results)
+    if expect_finishers is not None:
+        assert sorted(finisher_models) == expect_finishers, _dump(results)
+    if scenario == "double_join":
+        assert len(next(iter(rosters))) == 4, _dump(results)
+    if scenario == "shrink_to_one":
+        assert next(iter(rosters)) == (0,), _dump(results)
