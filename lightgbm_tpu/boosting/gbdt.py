@@ -592,11 +592,19 @@ class GBDT:
         t_iter0 = _time.perf_counter()
         if self._membership is not None:
             # boundary snapshot for exact replay: a mid-iteration peer
-            # failure rolls the RNG streams (and the bagging mask) back
-            # so the retried iteration draws identical samples
-            self._member_iter_snapshot = (
-                self.bag_rng.get_state(), self.feature_rng.get_state(),
-                self.select)
+            # failure rolls the RNG streams, the bagging mask AND the f32
+            # score caches back so the retried iteration replays from a
+            # bit-identical state (device arrays are immutable, so the
+            # score snapshots are reference-captures, not copies)
+            self._member_iter_snapshot = {
+                "bag_rng": self.bag_rng.get_state(),
+                "feature_rng": self.feature_rng.get_state(),
+                "select": self.select,
+                "num_models": len(self.models),
+                "boost_from_average": self.boost_from_average_,
+                "scores": self.scores,
+                "valid_scores": tuple(self.valid_scores),
+            }
         self._boost_from_average()
 
         # comms-volume accounting: the host-driven parallel learners keep
@@ -1304,6 +1312,13 @@ class GBDT:
         if self.learner is not None and hasattr(self.learner, "set_plan"):
             self.learner.set_plan(new_plan)
         self._rebalance["plan"] = new_plan
+        if self._membership is not None:
+            # rt.counts mirrors the epoch record, which only refreshes at
+            # epoch commits — but eviction synthesis reads it as the LIVE
+            # row layout.  Every member applies the identical plan in
+            # lockstep (the controller is deterministic), so updating it
+            # here keeps the whole fleet's view consistent mid-epoch.
+            self._membership.counts = tuple(int(c) for c in new_plan.counts)
         # injected per-collective delays model per-row-slow hosts: their
         # stall shrinks with the rank's row share (bench.py elastic)
         _net.set_delay_scale(n_new / max(self._initial_local_rows, 1))
@@ -1345,10 +1360,28 @@ class GBDT:
 
     def _membership_rollback_partial(self) -> None:
         """Undo partially-grown iteration state left by a mid-grow peer
-        failure so the retry replays from the boundary.  With one tree
-        per iteration nothing is ever partial (the grower fails before
-        the model is appended); multi-class iterations subtract the
-        already-scored classes back out via the full binned traversal."""
+        failure so the retry replays from the boundary.  The boundary
+        snapshot restores the score caches by reference, so the retry is
+        bit-identical to a fleet that never saw the failure — including
+        multi-class iterations, where arithmetically un-adding a tree
+        would not round-trip (fl(fl(a+v)-v) != a in general).  The
+        subtraction fallback only covers paths that never took a
+        snapshot (e.g. the fused partitioned trainer's)."""
+        snap = getattr(self, "_member_iter_snapshot", None)
+        if snap is not None:
+            # a first-iteration failure may land after _boost_from_average
+            # ran: the snapshot predates it, so the constant tree and its
+            # score shift roll back too and the retry re-derives the
+            # global average on the resized fleet (same bytes — the
+            # average is over the invariant global dataset)
+            del self.models[snap["num_models"]:]
+            self.boost_from_average_ = snap["boost_from_average"]
+            self.scores = snap["scores"]
+            self.valid_scores = list(snap["valid_scores"])
+            self.bag_rng.set_state(snap["bag_rng"])
+            self.feature_rng.set_state(snap["feature_rng"])
+            self.select = snap["select"]
+            return
         k = self.num_tree_per_iteration
         complete = self.iter * k + (1 if self.boost_from_average_ else 0)
         extra = self.models[complete:]
@@ -1358,11 +1391,6 @@ class GBDT:
                 self._add_tree_to_train_scores(tree, kk)
                 self._add_tree_to_valid_scores(tree, kk)
         del self.models[complete:]
-        snap = getattr(self, "_member_iter_snapshot", None)
-        if snap is not None:
-            self.bag_rng.set_state(snap[0])
-            self.feature_rng.set_state(snap[1])
-            self.select = snap[2]
 
     def _membership_capture(self):
         """Snapshot this member's TrainState (ckpt.capture without the
@@ -1432,7 +1460,12 @@ class GBDT:
             raise _net.PeerFailureError(
                 f"eviction under boosting type {type(self).__name__} is "
                 "not supported: score replay assumes immutable past trees")
-        old_plan = ShardPlan.from_counts(rt.counts)
+        # the LIVE layout, not the epoch record: a runtime rebalance moves
+        # rows mid-epoch, so when the rebalancer is armed its applied plan
+        # is authoritative (rt.counts is also kept in sync by
+        # _apply_rebalance — this guards against any reader that isn't)
+        old_plan = (self._rebalance["plan"] if self._rebalance is not None
+                    else ShardPlan.from_counts(rt.counts))
         lo, hi = old_plan.rank_range(rt.members.index(member))
         X, y = rt.row_provider(lo, hi)
         ts = self.train_set

@@ -16,8 +16,8 @@ module closes that loop and measures it:
     per-member member-seconds priced by the trace, every preemption /
     spawn event, and fleet-wide iteration completions harvested from
     the membership KV store.  ``zero_lost_iterations`` proves the
-    economic premise — survivors resized in RAM, no completed iteration
-    was redone or discarded.
+    economic premise — survivors resized in RAM, no iteration was lost,
+    and (via per-attempt epoch-keyed records) none was redone.
 
 ``SpotFleet``
     Drives REAL worker subprocesses (tests/membership_worker.py by
@@ -171,6 +171,7 @@ class CostLedger:
             "cost": {},             # member key -> priced spend
             "events": [],           # preempt/spawn/price changes, timed
             "iterations": {},       # iter -> {"epoch": E, "t_s": ...}
+            "attempts": {},         # "iter.mM" -> [epochs it completed in]
             "total_cost": 0.0,
             "completed": False,
             "trees": None,
@@ -193,6 +194,16 @@ class CostLedger:
         self._doc["iterations"].setdefault(
             str(it), {"epoch": epoch, "t_s": round(t_s, 3)})
 
+    def attempt(self, it: int, member, epoch: int) -> None:
+        """One member completed iteration ``it`` under ``epoch`` (from a
+        write-once ``attempts/<it>.m<member>.e<epoch>`` KV record —
+        idempotent, the harvest loop re-reads the store every poll)."""
+        epochs = self._doc.setdefault("attempts", {}).setdefault(
+            f"{int(it)}.m{member}", [])
+        if int(epoch) not in epochs:
+            epochs.append(int(epoch))
+            epochs.sort()
+
     def finish(self, trees: int) -> None:
         self._doc["completed"] = True
         self._doc["trees"] = int(trees)
@@ -203,14 +214,21 @@ class CostLedger:
         return float(self._doc["total_cost"])
 
     def zero_lost_iterations(self) -> bool:
-        """Every trained iteration 0..trees-1 was completed exactly once
-        fleet-wide (the per-iteration KV records are write-once, so a
-        redone iteration could not re-claim its slot)."""
+        """No training iteration was lost OR redone across the churn:
+        the write-once ``progress/<it>`` slots must cover exactly
+        ``0..trees-1`` (nothing lost), and — when per-attempt records
+        were harvested — no member may have completed the same iteration
+        under two different epochs (nothing redone; a redo necessarily
+        lands in a later epoch, so it leaves a second attempt key even
+        though it cannot re-claim the write-once progress slot)."""
         trees = self._doc["trees"]
         if not self._doc["completed"] or trees is None:
             return False
         got = sorted(int(k) for k in self._doc["iterations"])
-        return got == list(range(int(trees)))
+        if got != list(range(int(trees))):
+            return False
+        attempts = self._doc.get("attempts") or {}
+        return all(len(epochs) == 1 for epochs in attempts.values())
 
     def cost_per_model(self) -> Optional[float]:
         return self.total_cost if self._doc["completed"] else None
@@ -298,16 +316,23 @@ class SpotFleet:
         return env
 
     def _spawn(self, member_arg) -> dict:
-        proc = subprocess.Popen(
-            [sys.executable, self.worker, str(member_arg), self.fleet_dir,
-             self.out],
-            env=self._env(), stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True)
         key = str(member_arg)
         if member_arg == "join":
             # ledger keys must be unique per worker, not per argv form
             key = f"join{sum(1 for r in self._procs if r['kind'] == 'join') + 1}"
-        rec = dict(proc=proc, key=key, kind=(
+        # per-member log file, NOT a pipe: nothing drains a pipe until the
+        # run ends, so a chatty worker (verbose>=1 over many iterations)
+        # would block on the full OS pipe buffer and stall the fleet into
+        # a spurious timeout — and the files survive for post-mortems
+        os.makedirs(self.fleet_dir, exist_ok=True)
+        log_path = os.path.join(self.fleet_dir, f"worker.{key}.log")
+        with open(log_path, "w") as log_fh:
+            proc = subprocess.Popen(
+                [sys.executable, self.worker, str(member_arg),
+                 self.fleet_dir, self.out],
+                env=self._env(), stdout=log_fh, stderr=subprocess.STDOUT,
+                text=True)
+        rec = dict(proc=proc, key=key, log=log_path, kind=(
             "join" if member_arg == "join" else "bootstrap"))
         self._procs.append(rec)
         return rec
@@ -350,6 +375,15 @@ class SpotFleet:
             except (ValueError, KeyError, TypeError):
                 epoch = -1
             self.ledger.iteration(it, epoch, t)
+        for key, _value in client.key_value_dir_get("attempts/"):
+            # "attempts/<it>.m<member>.e<epoch>" — one write-once key per
+            # completion attempt, feeding the nothing-redone proof
+            name = key.rsplit("/", 1)[-1]
+            try:
+                it_s, m_s, e_s = name.split(".")
+                self.ledger.attempt(int(it_s), m_s[1:], int(e_s[1:]))
+            except (ValueError, IndexError):
+                Log.warning("spot: unparsable attempt key %r", key)
 
     # -- run -----------------------------------------------------------
     def run(self, timeout_s: float = 300.0) -> dict:
@@ -397,7 +431,7 @@ class SpotFleet:
     def _collect(self) -> dict:
         exits, models, metas = {}, {}, {}
         for rec in self._procs:
-            rec["proc"].communicate()
+            rec["proc"].wait()
             exits[rec["key"]] = rec["proc"].returncode
         for name in sorted(os.listdir(self.fleet_dir)):
             if name.startswith("out.m") and name.endswith(".txt"):

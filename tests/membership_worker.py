@@ -27,7 +27,8 @@ Env knobs (set by the parent):
   MEMBER_REBALANCE=1 — arm straggler-aware shard rebalancing
   MEMBER_QUANTIZED=0 — disable quantized training (default on)
   MEMBER_PROGRESS=1 — publish write-once ``progress/<iter>`` KV records
-      (first finisher claims the slot) for the spot cost ledger
+      (first finisher claims the slot) plus per-attempt
+      ``attempts/<iter>.m<id>.e<epoch>`` keys for the spot cost ledger
 plus the standard LIGHTGBM_TPU_FAULT / _TRACE / _NET_* hooks.
 
 Exit codes: 0 on completed model OR clean leave; EXIT_PEER_FAILURE (75)
@@ -134,6 +135,11 @@ try:
             rt.client.try_create(
                 f"progress/{it}",
                 json.dumps({"epoch": rt.epoch, "member": mid}).encode())
+            # per-attempt record: epoch-keyed, so the SAME member
+            # completing the SAME iteration twice (a redo — resizes
+            # always bump the epoch) leaves two keys the ledger can see;
+            # this is what upgrades "no iteration lost" to "none redone"
+            rt.client.try_create(f"attempts/{it}.m{mid}.e{rt.epoch}", b"1")
         if LEAVE_ITER >= 0 and it >= LEAVE_ITER:
             rt.request_leave()
         if SIGTERM_ITER >= 0 and it >= SIGTERM_ITER:

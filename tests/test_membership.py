@@ -268,6 +268,116 @@ def test_membership_rejects_query_grouped_data(tmp_path):
         rt.stop()
 
 
+def test_membership_synthesize_uses_live_rebalance_plan(tmp_path):
+    """Eviction synthesis must regenerate the dead member's rows from
+    the LIVE shard layout: after a runtime rebalance the epoch record's
+    counts are stale, and synthesizing from them would duplicate some
+    rows and drop others in the canonical merge."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.parallel import membership
+    from lightgbm_tpu.parallel.membership import MembershipRuntime
+    from lightgbm_tpu.parallel.shardplan import ShardPlan
+
+    rng = np.random.default_rng(9)
+    X = rng.integers(0, 5, size=(600, 6)).astype(np.float32)
+    y = (rng.random(600) < 0.5).astype(np.float32)
+    rt = MembershipRuntime(str(tmp_path / "fleet"), 0)
+    rt.bootstrap(1, (600,))
+    rt.row_provider = lambda lo, hi: (X[lo:hi], y[lo:hi])
+    membership.set_runtime(rt)
+    try:
+        p = dict(objective="binary", tree_learner="data",
+                 pre_partition=True, elastic_membership=True,
+                 num_leaves=5, min_data_in_leaf=20,
+                 boost_from_average=False, verbose=-1)
+        ds = lgb.Dataset(X, label=y, params=dict(p))
+        bst = lgb.train(p, ds, num_boost_round=3)
+        g = bst.boosting
+        assert g._membership is rt
+        import zlib
+
+        def _crc_label(lo):
+            lab = y[lo:].astype(
+                np.asarray(g.train_set.metadata.label).dtype)
+            return zlib.crc32(np.ascontiguousarray(lab).tobytes()) \
+                & 0xFFFFFFFF
+
+        # pretend this is a 2-member fleet whose epoch record says
+        # (360, 240) ...
+        rt.members = (0, 1)
+        rt.counts = (360, 240)
+        own = g._membership_capture()
+        stale = g._membership_synthesize(1, own)
+        assert stale.meta["num_data"] == 240
+        assert stale.meta["data_fingerprint_parts"]["crc_label"] \
+            == _crc_label(360)
+        # ... but a runtime rebalance has since moved the cut to
+        # (200, 400): the armed plan, not the stale epoch counts, must
+        # drive the regeneration
+        g._rebalance = {"plan": ShardPlan.from_counts((200, 400)),
+                        "ctl": None, "rank": 0, "group_bounds": None}
+        live = g._membership_synthesize(1, own)
+        assert live.meta["num_data"] == 400
+        assert live.meta["data_fingerprint_parts"]["crc_label"] \
+            == _crc_label(200)
+        assert live.arrays["scores"].shape == (1, 400)
+        # rows [360, 600) appear in both regenerations: their replayed
+        # scores must agree bit-for-bit (per-row-independent replay)
+        assert np.array_equal(live.arrays["scores"][:, 160:],
+                              stale.arrays["scores"])
+    finally:
+        membership.set_runtime(None)
+        rt.stop()
+
+
+def test_membership_rollback_restores_boundary_state_bitwise(tmp_path):
+    """A mid-grow rollback must replay from a bit-identical boundary
+    state.  Multi-class is the sharp case: un-adding a tree from the f32
+    score cache arithmetically (fl(fl(a+v)-v)) does not round-trip, so
+    the snapshot restores the caches by reference instead."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(17)
+    X = rng.integers(0, 6, size=(400, 5)).astype(np.float32)
+    y = rng.integers(0, 3, size=400).astype(np.float32)
+    p = dict(objective="multiclass", num_class=3, num_leaves=6,
+             min_data_in_leaf=15, learning_rate=0.2, seed=3, verbose=-1)
+
+    ds = lgb.Dataset(X, label=y, params=dict(p))
+    ref = lgb.Booster(params=dict(p), train_set=ds)
+    for _ in range(6):
+        ref.update()
+    ref_model = ref.model_to_string()
+
+    ds2 = lgb.Dataset(X, label=y, params=dict(p))
+    bst = lgb.Booster(params=dict(p), train_set=ds2)
+    for _ in range(4):
+        bst.update()
+    g = bst.boosting
+    # the boundary snapshot _train_one_iter_impl takes under membership
+    snap = {
+        "bag_rng": g.bag_rng.get_state(),
+        "feature_rng": g.feature_rng.get_state(),
+        "select": g.select,
+        "num_models": len(g.models),
+        "boost_from_average": g.boost_from_average_,
+        "scores": g.scores,
+        "valid_scores": tuple(g.valid_scores),
+    }
+    boundary_scores = np.asarray(g.scores, np.float32).copy()
+    bst.update()  # iteration 5 grows 3 trees and advances the caches
+    assert len(g.models) > snap["num_models"]
+    g._member_iter_snapshot = snap
+    g._membership_rollback_partial()
+    g.iter -= 1  # the real path fails BEFORE the boundary increments it
+    assert len(g.models) == snap["num_models"]
+    assert (np.asarray(g.scores, np.float32).tobytes()
+            == boundary_scores.tobytes()), "score cache not bit-restored"
+    for _ in range(2):  # replay iteration 5, then train 6
+        bst.update()
+    assert bst.model_to_string() == ref_model
+
+
 # ----------------------------------------------------------------------
 # epoch-scoped uid seams (net.epoch_uid layout, collect.set_epoch,
 # comm.epoch, distributed.current_epoch)

@@ -120,6 +120,25 @@ def test_ledger_iteration_records_are_write_once(tmp_path):
     assert led._doc["iterations"]["0"]["epoch"] == 0
 
 
+def test_ledger_detects_redone_iteration(tmp_path):
+    """The nothing-redone half of the proof: a member that completed the
+    same iteration under two epochs (a redo — resizes bump the epoch)
+    fails the gate even though the write-once progress slots are
+    gap-free."""
+    led = CostLedger(str(tmp_path / "l.json"))
+    for it in range(3):
+        led.iteration(it, 0, 0.0)
+        led.attempt(it, "0", 0)
+    led.attempt(2, "0", 0)          # idempotent re-harvest: same epoch
+    led.finish(3)
+    led.flush()
+    assert led.zero_lost_iterations()
+    assert CostLedger.load(led.path).zero_lost_iterations()
+    led.attempt(2, "0", 1)          # the same member redid iteration 2
+    assert led._doc["attempts"]["2.m0"] == [0, 1]
+    assert not led.zero_lost_iterations()
+
+
 def test_ledger_version_mismatch_is_loud(tmp_path):
     path = str(tmp_path / "l.json")
     with open(path, "w") as fh:
@@ -155,6 +174,14 @@ def test_spot_fleet_preempt_respawn_e2e(tmp_path):
     assert led.total_cost == pytest.approx(summary["cost"])
     kinds = [e["kind"] for e in led._doc["events"]]
     assert "preempt" in kinds and "spawn" in kinds
+    # per-attempt records were harvested and prove nothing was redone
+    assert led._doc["attempts"], "no attempt keys harvested"
+    assert all(len(v) == 1 for v in led._doc["attempts"].values())
+    # workers log to per-member files (an undrained pipe would stall a
+    # chatty worker on the OS buffer); the SIGKILLed member's log stays
+    # for the post-mortem
+    for key in ("0", "1"):
+        assert os.path.exists(os.path.join(fleet_dir, f"worker.{key}.log"))
     # the ledger priced at spot, not on-demand: total member-seconds x
     # base price bounds the document's spend
     secs = sum(led._doc["member_seconds"].values())
