@@ -162,6 +162,21 @@ def apply_regression_gate(out: dict, bench_dir: str = None, env=None) -> int:
         if isinstance(ratio_s, (int, float)) and float(ratio_s) > 0.8:
             out["regression_spot_cost"] = True
             rc = 1
+    # serving-tail leg, same regime: the injected per-request delay
+    # dominates any backend's own latency, so hedged p99 under chaos
+    # staying <= 3x the healthy-baseline p99 is a protocol-level
+    # contract of the hedging/breaker machinery — it gates outright
+    # even on backend_fallback captures (docs/ROBUSTNESS.md)
+    stl = out.get("serving_tail") or {}
+    ratio_t = stl.get("hedged_chaos_over_healthy_p99")
+    if stl and not stl.get("error") and isinstance(ratio_t, (int, float)):
+        out["gate_serving_tail"] = {
+            "max_hedged_chaos_over_healthy_p99": 3.0,
+            "hedged_chaos_over_healthy_p99": round(float(ratio_t), 3),
+        }
+        if float(ratio_t) > 3.0:
+            out["regression_serving_tail"] = True
+            rc = 1
     if out.get("backend_fallback"):
         return rc
     best, src = best_prior_sec_per_iter(bench_dir, out.get("metric"))
@@ -1069,6 +1084,116 @@ def _bench_factory(X, y):
     except Exception as e:  # pragma: no cover — factory must not kill bench
         section["error"] = f"{type(e).__name__}: {e}"
     finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return section
+
+
+def _bench_serving_tail(booster, X):
+    """Serving-tail benchmark (docs/ROBUSTNESS.md): hedged vs unhedged
+    client p99 through a 3-replica subprocess fleet whose first replica
+    is wounded with an injected per-request delay via
+    ``LIGHTGBM_TPU_SERVE_FAULT`` — the gray-failure scenario the hedging
+    + breaker machinery exists for.  Three proxy legs over the same
+    fleet: healthy (clean replicas only), chaos unhedged, chaos hedged.
+    The hedged-chaos-over-healthy p99 ratio is protocol-level (the
+    injected delay dominates any backend's own latency), so it is the
+    device-independent leg of the regression gate.  BENCH_SERVING_TAIL=0
+    skips; BENCH_SERVING_TAIL_REQS resizes the per-leg request count."""
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    from lightgbm_tpu.serve import ModelRegistry, PredictorArtifact
+    from lightgbm_tpu.serve.fleet import (FleetProxy, _wait_ready,
+                                          spawn_replicas)
+
+    section = {}
+    reps = int(os.environ.get("BENCH_SERVING_TAIL_REQS", 90))
+    delay_ms = 300.0
+    hedge_ms = 25.0
+    root = tempfile.mkdtemp(prefix="bench_servetail_")
+    procs = []
+
+    def p99(lats):
+        vals = sorted(lats)
+        return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
+
+    def measure(backends, hedge_delay_ms):
+        proxy = FleetProxy(("127.0.0.1", 0), backends,
+                           health_poll_s=0.2, retry_deadline_s=20.0,
+                           backend_timeout_s=5.0,
+                           hedge_delay_ms=hedge_delay_ms,
+                           hedge_budget_pct=100.0)
+        threading.Thread(target=proxy.serve_forever, daemon=True).start()
+        lats = []
+        try:
+            url = f"http://127.0.0.1:{proxy.server_address[1]}/predict"
+            for _ in range(reps):
+                req = urllib.request.Request(url, data=body)
+                req.add_header("X-Deadline-Ms", "15000")
+                t0 = time.perf_counter()
+                urllib.request.urlopen(req, timeout=60).read()
+                lats.append(time.perf_counter() - t0)
+            return lats, proxy.stats()
+        finally:
+            proxy.shutdown()
+            proxy.server_close()
+
+    try:
+        reg_dir = os.path.join(root, "reg")
+        ModelRegistry(reg_dir).publish(
+            PredictorArtifact.from_booster(booster))
+        # replicas are pinned to CPU: the tail numbers are protocol-
+        # level (delay-dominated), and the bench's own device stays free
+        cpu = {"JAX_PLATFORMS": "cpu"}
+        procs = spawn_replicas(
+            3, {"registry": reg_dir, "warmup_max_rows": "64",
+                "max_delay_ms": "1", "registry_poll_ms": "200"},
+            envs=[dict(cpu, LIGHTGBM_TPU_SERVE_FAULT=f"delay:{delay_ms:g}"),
+                  dict(cpu), dict(cpu)])
+        for _, port in procs:
+            if not _wait_ready("127.0.0.1", port, 180.0):
+                raise RuntimeError(f"replica on port {port} never ready")
+        addrs = [f"127.0.0.1:{p}" for _, p in procs]
+        body = "\n".join(json.dumps(list(map(float, r)))
+                         for r in np.asarray(X[:2], float)).encode()
+
+        healthy_lats, _ = measure(addrs[1:], -1.0)
+        unhedged_lats, _ = measure(addrs, -1.0)
+        hedged_lats, hst = measure(addrs, hedge_ms)
+
+        # the ratio denominator is floored: a microsecond-fast healthy
+        # fleet would otherwise turn the fixed hedge delay into a huge
+        # "slowdown" that says nothing about tail behavior
+        floor_s = 0.020
+        healthy_p99 = p99(healthy_lats)
+        denom = max(healthy_p99, floor_s)
+        section = {
+            "requests_per_leg": reps,
+            "injected_delay_ms": delay_ms,
+            "hedge_delay_ms": hedge_ms,
+            "gate_floor_ms": round(1e3 * floor_s, 1),
+            "healthy_p99_ms": round(1e3 * healthy_p99, 2),
+            "unhedged_chaos_p99_ms": round(1e3 * p99(unhedged_lats), 2),
+            "hedged_chaos_p99_ms": round(1e3 * p99(hedged_lats), 2),
+            "unhedged_chaos_over_healthy_p99": round(
+                p99(unhedged_lats) / denom, 3),
+            "hedged_chaos_over_healthy_p99": round(
+                p99(hedged_lats) / denom, 3),
+            "hedges_launched": hst["hedges"]["launched"],
+            "hedge_wins": hst["hedges"]["wins"],
+        }
+    except Exception as e:  # pragma: no cover — tail bench must not kill bench
+        section["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        for p, _ in procs:
+            p.kill()
+        for p, _ in procs:
+            try:
+                p.wait(timeout=30)
+            except Exception:
+                pass
         shutil.rmtree(root, ignore_errors=True)
     return section
 
@@ -2106,6 +2231,14 @@ def main():
     # tree-count-matched cold retrain, canary-window plumbing overhead
     if os.environ.get("BENCH_FACTORY", "0" if backend_fallback else "1") != "0":
         out["factory"] = _bench_factory(X, y)
+
+    # serving-tail section (docs/ROBUSTNESS.md): hedged vs unhedged
+    # client p99 through a 3-replica fleet with one delay-injected
+    # replica.  Runs even on backend_fallback: the injected delay
+    # dominates, so the hedged-chaos-over-healthy ratio is a
+    # device-independent leg of the regression gate.
+    if os.environ.get("BENCH_SERVING_TAIL", "1") != "0":
+        out["serving_tail"] = _bench_serving_tail(booster, X)
 
     # comms section (docs/PARALLEL.md): bytes/iter + s/iter of the
     # data/feature/voting distributed learners on a >=2000-feature

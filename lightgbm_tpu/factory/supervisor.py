@@ -73,6 +73,9 @@ DEFAULTS = {
     "max_cycles": 0,         # stop after N completed runs (0 = forever)
     "canary_warmup_rows": 256,     # canary replica warmup ladder cap
     "ready_timeout_ms": 120000.0,  # canary replica readiness deadline
+    "max_registry_stale_s": 30.0,  # refuse to promote against a fleet
+                                   # replica whose registry swaps have
+                                   # been failing longer (0 disables)
 }
 
 EXIT_OK = 0
@@ -174,12 +177,62 @@ class FactorySupervisor:
         with tracer.span("factory.publish", run_id=run["run_id"]):
             version = self._publish(run, model_path)
         ok, detail = self._eval_gate(run, run_dir, model_path)
+        if ok and self.proxy \
+                and float(self.opts["max_registry_stale_s"]) > 0:
+            ok, stale_detail = self._fleet_fresh()
+            detail.update(stale_detail)
         if ok and self.proxy and float(self.opts["canary_fraction"]) > 0 \
                 and float(self.opts["observe_s"]) > 0:
             with tracer.span("factory.canary", version=version):
                 ok, canary_detail = self._canary(version)
             detail.update(canary_detail)
         return self._finish(run, run_dir, model_path, version, ok, detail)
+
+    # -- fleet freshness gate ------------------------------------------
+    def _fleet_fresh(self) -> Tuple[bool, Dict]:
+        """A fleet replica whose registry swaps keep failing serves
+        last-good no matter what we activate — promoting against it
+        only *pretends* to ship the candidate.  Walk the proxy's
+        healthy backends and refuse to promote while any reports
+        ``registry.stale_seconds`` beyond the knob."""
+        limit = float(self.opts["max_registry_stale_s"])
+        proxy_host, _, proxy_port_s = self.proxy.rpartition(":")
+        proxy_host, proxy_port = (proxy_host or "127.0.0.1",
+                                  int(proxy_port_s))
+        detail: Dict = {"fleet": {"max_registry_stale_s": limit,
+                                  "stale_backends": {}}}
+        det = detail["fleet"]
+        try:
+            st = _http_json(proxy_host, proxy_port, "GET", "/fleet/stats")
+        except (OSError, ValueError) as e:
+            det["reason"] = f"cannot read fleet stats: {e}"
+            return False, detail
+        worst = 0.0
+        for b in (st or {}).get("backends", []):
+            if not b.get("healthy"):
+                continue  # reachability is the prober's problem
+            host, _, port_s = str(b.get("addr", "")).rpartition(":")
+            try:
+                bs = _http_json(host or "127.0.0.1", int(port_s),
+                                "GET", "/stats")
+            except (OSError, ValueError):
+                continue  # transiently unreachable: the prober will eject
+            stale = float((bs or {}).get("registry", {})
+                          .get("stale_seconds") or 0.0)
+            if stale > 0:
+                det["stale_backends"][b["addr"]] = round(stale, 1)
+            worst = max(worst, stale)
+        det["max_stale_s"] = round(worst, 1)
+        if worst > limit:
+            det["reason"] = (
+                f"fleet registry staleness {worst:.1f}s > "
+                f"{limit:.1f}s on {sorted(det['stale_backends'])} — an "
+                f"activation would not reach those replicas; fix the "
+                f"registry before promoting")
+            tracer.event("factory.fleet_stale", max_stale_s=worst,
+                         backends=sorted(det["stale_backends"]))
+            return False, detail
+        return True, detail
 
     def _stage_data(self, run: Dict, run_dir: str) -> str:
         """Concatenate the watched chunks (lexical order) into one
@@ -420,7 +473,7 @@ class FactorySupervisor:
             tracer.counter("factory.promotions")
         else:
             reason = "unspecified regression"
-            for block in ("canary", "eval"):
+            for block in ("canary", "fleet", "eval"):
                 d = detail.get(block)
                 if isinstance(d, dict) and d.get("reason"):
                     reason = d["reason"]

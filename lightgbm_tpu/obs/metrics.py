@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import re
 import threading
+from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 PREFIX = "lightgbm_tpu_"
@@ -197,6 +198,37 @@ class Histogram:
         out.append(f"{self.name}_sum {_fmt(s)}")
         out.append(f"{self.name}_count {total}")
         return out
+
+
+class RollingQuantile:
+    """Exact quantiles over a sliding window of the last ``window``
+    observations.  Unlike :class:`Histogram` (cumulative, bucket
+    resolution) this *adapts*: the fleet proxy derives its hedge delay
+    from the p95 of recent attempt latencies, so the trigger tracks the
+    fleet's current speed instead of its lifetime average.  Not a
+    Prometheus metric — a control-loop input."""
+
+    def __init__(self, window: int = 512):
+        self._window = max(1, int(window))
+        self._buf: deque = deque(maxlen=self._window)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._buf.append(float(value))
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def quantile(self, q: float) -> float:
+        """Exact order statistic over the window (0.0 when empty)."""
+        with self._lock:
+            vals = sorted(self._buf)
+        if not vals:
+            return 0.0
+        i = min(len(vals) - 1, max(0, int(float(q) * len(vals))))
+        return vals[i]
 
 
 class LabeledFamily:

@@ -160,6 +160,15 @@ class MicroBatcher:
         if rows.ndim == 1:
             rows = rows.reshape(1, -1)
         tmo = self.request_timeout_ms if timeout_ms is None else float(timeout_ms)
+        if tmo <= 0:
+            # deadline propagation (docs/ROBUSTNESS.md): a request whose
+            # X-Deadline-Ms budget is already spent fails fast — no
+            # queue slot, no device work
+            with self._lock:
+                self._counts["timeouts"] += 1
+            _M_TIMEOUTS.inc()
+            tracer.counter("serve_request_timeout")
+            raise RequestTimeout("deadline exhausted on arrival")
         req = _Request(rows, deadline=time.monotonic() + tmo / 1e3)
         if rows.shape[0] == 0:
             req.result = np.empty((0,))

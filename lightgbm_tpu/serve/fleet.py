@@ -23,6 +23,26 @@ idempotent, so a SIGKILLed replica mid-request costs a retry, never a
 dropped response), and a background ``/readyz`` prober that restores
 recovered backends.  ``python -m lightgbm_tpu fleet`` spawns N
 ``serve`` subprocesses on a shared model registry plus the proxy.
+
+Crash failures are the easy third of the story; the proxy also holds
+the gray-failure line (docs/ROBUSTNESS.md):
+
+- **deadline propagation** — a client ``X-Deadline-Ms`` budget bounds
+  the whole relay; each backend attempt gets the shrunken remainder
+  and a matching socket timeout, so a hung replica costs a bounded
+  slice of the budget instead of the full 30 s socket timeout;
+- **hedged requests** — an idempotent predict that outlives the hedge
+  delay (fixed, or adaptive p95 of recent attempt latencies) fires one
+  extra attempt at a different backend, first answer wins, volume
+  capped by a budget counter;
+- **latency-outlier circuit breakers** (serve/breaker.py) — per-backend
+  latency/error EWMA vs the fleet median opens a breaker on a replica
+  that is alive-but-wedged (``/readyz`` 200, ``/predict`` hangs — the
+  mode the health prober can never see) and restores it through a
+  single half-open probe;
+- **overload control** — bounded proxy concurrency + bounded wait
+  queue; excess load is shed with 503 + ``Retry-After`` instead of an
+  unbounded thread pile.
 """
 
 from __future__ import annotations
@@ -30,6 +50,7 @@ from __future__ import annotations
 import http.client
 import json
 import os
+import queue
 import signal
 import subprocess
 import sys
@@ -41,8 +62,10 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..obs import compilewatch, tracer
-from ..obs.metrics import LATENCY_BUCKETS, registry as metrics_registry
+from ..obs.metrics import (LATENCY_BUCKETS, RollingQuantile,
+                           registry as metrics_registry)
 from ..utils.log import Log
+from . import breaker as breaker_mod
 from .artifact import PackedPredictor, PredictorArtifact
 
 _M_SWAPS = metrics_registry.counter(
@@ -69,6 +92,24 @@ _M_PROXY_LATENCY = metrics_registry.histogram(
 _M_PROXY_CANARY = metrics_registry.counter(
     "lightgbm_tpu_proxy_canary_requests_total",
     "predict requests answered by the canary backend")
+_M_PROXY_HEDGES = metrics_registry.counter(
+    "lightgbm_tpu_proxy_hedges_total",
+    "hedge attempts launched for slow predicts")
+_M_PROXY_HEDGE_WINS = metrics_registry.counter(
+    "lightgbm_tpu_proxy_hedge_wins_total",
+    "predicts where the hedge attempt answered first")
+_M_PROXY_BREAKER_OPENS = metrics_registry.counter(
+    "lightgbm_tpu_proxy_breaker_opens_total",
+    "circuit-breaker CLOSED/HALF_OPEN -> OPEN transitions")
+_M_PROXY_BREAKER_CLOSES = metrics_registry.counter(
+    "lightgbm_tpu_proxy_breaker_closes_total",
+    "circuit-breaker HALF_OPEN -> CLOSED restorations")
+_M_PROXY_SHED = metrics_registry.counter(
+    "lightgbm_tpu_proxy_shed_total",
+    "requests shed by proxy overload control (503 + Retry-After)")
+_M_PROXY_DEADLINE = metrics_registry.counter(
+    "lightgbm_tpu_proxy_deadline_rejected_total",
+    "requests 504ed at the proxy because the X-Deadline-Ms budget ran out")
 
 
 # ----------------------------------------------------------------------
@@ -236,7 +277,11 @@ class FleetProxy(ThreadingHTTPServer):
 
     def __init__(self, addr, backends: List[str], policy: str = "least_loaded",
                  backend_timeout_s: float = 30.0, health_poll_s: float = 0.5,
-                 retry_deadline_s: float = 10.0):
+                 retry_deadline_s: float = 10.0,
+                 hedge_delay_ms: float = 0.0, hedge_budget_pct: float = 10.0,
+                 breaker_k: float = 3.0, breaker_m: int = 5,
+                 breaker_open_ms: float = 2000.0,
+                 max_concurrent: int = 128, max_queue: int = 256):
         if not backends:
             Log.fatal("fleet proxy needs at least one backend")
         if policy not in ("least_loaded", "rr"):
@@ -246,6 +291,25 @@ class FleetProxy(ThreadingHTTPServer):
         self.backend_timeout_s = float(backend_timeout_s)
         self.health_poll_s = float(health_poll_s)
         self.retry_deadline_s = float(retry_deadline_s)
+        # gray-failure hardening (docs/ROBUSTNESS.md serving table):
+        # hedge_delay_ms: fixed hedge trigger; 0 = adaptive (p95 of the
+        # recent attempt-latency window); <0 disables hedging entirely
+        self.hedge_delay_ms = float(hedge_delay_ms)
+        self.hedge_budget_pct = float(hedge_budget_pct)
+        self.breaker = breaker_mod.LatencyBreaker(
+            k=float(breaker_k), m=int(breaker_m),
+            open_s=float(breaker_open_ms) / 1e3)
+        self.max_concurrent = int(max_concurrent)
+        self.max_queue = int(max_queue)
+        self._lat_window = RollingQuantile(window=512)
+        self._fwd_requests = 0
+        self._hedges = 0
+        self._hedge_wins = 0
+        self._shed = 0
+        self._deadline_rejected = 0
+        self._ocv = threading.Condition(threading.Lock())
+        self._active = 0
+        self._waiting = 0
         self._block = threading.Lock()
         self._rr = 0
         self._stop = threading.Event()
@@ -261,6 +325,14 @@ class FleetProxy(ThreadingHTTPServer):
             "lightgbm_tpu_proxy_healthy_backends",
             "backends currently accepting traffic",
             fn=lambda: float(sum(1 for b in self.backends if b.healthy)))
+        metrics_registry.gauge(
+            "lightgbm_tpu_proxy_open_breakers",
+            "backends whose circuit breaker is OPEN or HALF_OPEN",
+            fn=lambda: float(self.breaker.open_count()))
+        metrics_registry.gauge(
+            "lightgbm_tpu_proxy_inflight_requests",
+            "forwarded requests currently admitted by overload control",
+            fn=lambda: float(self._active))
         self._health_thread = threading.Thread(
             target=self._health_loop, name="ltpu-fleet-health", daemon=True)
         super().__init__(addr, _ProxyHandler)
@@ -278,6 +350,20 @@ class FleetProxy(ThreadingHTTPServer):
                 candidates = [b for b in self.backends if b.healthy]
             if not candidates:
                 return None
+            # circuit breakers (serve/breaker.py): a due half-open probe
+            # takes priority — that single request is what restores a
+            # recovered backend; otherwise route among CLOSED backends,
+            # and when every breaker is open fall back to all healthy
+            # (breakers advise, they never zero out availability)
+            trials = [b for b in candidates
+                      if self.breaker.trial_eligible(b.addr)]
+            if trials:
+                candidates = trials
+            else:
+                closed = [b for b in candidates
+                          if self.breaker.state(b.addr) == breaker_mod.CLOSED]
+                if closed:
+                    candidates = closed
             self._rr += 1
             if self.policy == "rr":
                 chosen = candidates[self._rr % len(candidates)]
@@ -288,9 +374,100 @@ class FleetProxy(ThreadingHTTPServer):
                 lo = min(b.inflight for b in candidates)
                 tied = [b for b in candidates if b.inflight == lo]
                 chosen = tied[self._rr % len(tied)]
+            self.breaker.begin_attempt(chosen.addr)
             chosen.inflight += 1
             chosen.requests += 1
             return chosen
+
+    def has_untried(self, tried: set) -> bool:
+        """A healthy backend outside ``tried`` exists — the 503 re-route
+        bound (counting against the live backend-list length shifts as
+        backends eject/restore mid-request; the tried set does not)."""
+        with self._block:
+            return any(b.healthy and b.addr not in tried
+                       for b in self.backends)
+
+    def note_result(self, backend: _Backend, elapsed_s: float,
+                    ok: bool) -> None:
+        """Feed one attempt's outcome to the breaker + hedge-delay
+        window and mirror breaker transitions to metrics/trace."""
+        transition = self.breaker.observe(backend.addr, elapsed_s, ok)
+        if ok:
+            self._lat_window.observe(elapsed_s)
+        if transition in ("open", "reopen"):
+            _M_PROXY_BREAKER_OPENS.inc()
+            Log.warning("fleet: breaker OPEN on %s (%s)", backend.addr,
+                        "probe failed" if transition == "reopen"
+                        else "latency/error outlier")
+        elif transition == "close":
+            _M_PROXY_BREAKER_CLOSES.inc()
+            Log.info("fleet: breaker CLOSED on %s (probe succeeded)",
+                     backend.addr)
+        if transition:
+            tracer.event("fleet.breaker", addr=backend.addr,
+                         transition=transition)
+
+    # -- hedging -------------------------------------------------------
+    def hedge_delay_s(self) -> Optional[float]:
+        """Current hedge trigger in seconds, or None when hedging is
+        off (negative knob or a single-backend fleet)."""
+        if self.hedge_delay_ms < 0 or len(self.backends) < 2:
+            return None
+        if self.hedge_delay_ms > 0:
+            return self.hedge_delay_ms / 1e3
+        # adaptive: p95 of the recent attempt-latency window, floored so
+        # a microsecond-fast fleet does not hedge-storm, with a cold
+        # fallback until the window has signal
+        if self._lat_window.count() < 20:
+            return 0.05
+        return max(0.025, self._lat_window.quantile(0.95))
+
+    def take_hedge_token(self) -> bool:
+        """Hedge budget: hedges may not exceed ``hedge_budget_pct`` % of
+        forwarded requests (with a small floor so early traffic can
+        still hedge before the denominator grows)."""
+        if self.hedge_budget_pct <= 0:
+            return False
+        with self._block:
+            allowed = max(5.0,
+                          self.hedge_budget_pct / 100.0 * self._fwd_requests)
+            if self._hedges + 1 > allowed:
+                return False
+            self._hedges += 1
+            return True
+
+    # -- overload control ----------------------------------------------
+    def admit(self, deadline: float) -> bool:
+        """Bounded concurrency + bounded wait queue: a forwarded request
+        either gets a concurrency slot (possibly after queueing until
+        ``deadline``) or is shed — the proxy never grows an unbounded
+        thread pile behind a slow fleet."""
+        if self.max_concurrent <= 0:
+            return True
+        with self._ocv:
+            if self._active < self.max_concurrent:
+                self._active += 1
+                return True
+            if self._waiting >= self.max_queue:
+                return False
+            self._waiting += 1
+            try:
+                while self._active >= self.max_concurrent:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                    self._ocv.wait(min(remaining, 0.05))
+                self._active += 1
+                return True
+            finally:
+                self._waiting -= 1
+
+    def unadmit(self) -> None:
+        if self.max_concurrent <= 0:
+            return
+        with self._ocv:
+            self._active = max(0, self._active - 1)
+            self._ocv.notify()
 
     # -- canary slice --------------------------------------------------
     def set_canary(self, addr: Optional[str],
@@ -368,17 +545,34 @@ class FleetProxy(ThreadingHTTPServer):
 
     # -- ops surface ---------------------------------------------------
     def stats(self) -> Dict:
+        breakers = self.breaker.snapshot()
         with self._block:
-            backends = [b.as_dict() for b in self.backends]
+            backends = [dict(b.as_dict(), breaker=breakers.get(b.addr))
+                        for b in self.backends]
             canary = (dict(self.canary.as_dict(),
                            fraction=self.canary_fraction)
                       if self.canary is not None else None)
+            hedges = {"launched": self._hedges, "wins": self._hedge_wins,
+                      "budget_pct": self.hedge_budget_pct,
+                      "delay_ms": self.hedge_delay_ms}
+            deadline_rejected = self._deadline_rejected
+            shed = self._shed
+        with self._ocv:
+            overload = {"active": self._active, "waiting": self._waiting,
+                        "shed": shed,
+                        "max_concurrent": self.max_concurrent,
+                        "max_queue": self.max_queue}
         return {
             "uptime_s": round(time.time() - self.t_start, 1),
             "policy": self.policy,
             "healthy": sum(1 for b in backends if b["healthy"]),
             "backends": backends,
             "canary": canary,
+            "hedges": hedges,
+            "overload": overload,
+            "open_breakers": sum(1 for s in breakers.values()
+                                 if s["state"] != breaker_mod.CLOSED),
+            "deadline_rejected": deadline_rejected,
         }
 
     def shutdown(self):
@@ -398,7 +592,8 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         sent = set()
         for k, v in headers or []:
-            if k.lower() in ("content-type", "x-model-version"):
+            if k.lower() in ("content-type", "x-model-version",
+                             "x-model-route", "retry-after"):
                 self.send_header(k, v)
                 sent.add(k.lower())
         if "content-type" not in sent:
@@ -448,86 +643,254 @@ class _ProxyHandler(BaseHTTPRequestHandler):
                 "fraction": self.server.canary_fraction,
             })
 
+    def _deadline_budget_ms(self) -> Optional[float]:
+        """Client ``X-Deadline-Ms`` budget, or None (absent/bad)."""
+        raw = self.headers.get("X-Deadline-Ms")
+        if not raw:
+            return None
+        try:
+            v = float(raw)
+        except ValueError:
+            return None
+        return v if v > 0 else 0.0
+
     def _forward(self, method: str, body: Optional[bytes]) -> None:
-        """Relay to a healthy backend; eject-and-retry on connection
-        failures, re-route 503s (draining/overloaded replica) when
-        another backend exists.  Predict requests are idempotent, so a
-        retry can never double-apply anything."""
+        """Relay to a healthy backend under the gray-failure contract:
+
+        - ``X-Deadline-Ms`` budget bounds the WHOLE relay (attempts,
+          queueing, retries); each backend attempt gets the shrunken
+          remainder forwarded and a socket timeout no larger than it,
+          so a hung replica costs a bounded timeout, never 30 s.
+        - Connection failures eject-and-retry; 503s re-route until the
+          set of backends *tried this round* is exhausted.
+        - Idempotent predicts that outlive the hedge delay fire ONE
+          hedge at a different backend; first response wins.
+        - Admission control sheds with 503 + ``Retry-After`` instead of
+          queueing unboundedly."""
         srv: FleetProxy = self.server
         t0 = time.perf_counter()
+        tm0 = time.monotonic()
         _M_PROXY_REQS.inc()
-        deadline = time.monotonic() + srv.retry_deadline_s
-        if method == "POST" and self.path.partition("?")[0] == "/predict":
-            canary = srv.pick_canary()
-            if canary is not None:
-                status = None
-                try:
-                    status, headers, payload = self._try_backend(
-                        srv, canary, method, body)
-                except (OSError, http.client.HTTPException):
-                    pass
-                finally:
-                    srv.release(canary)
-                if status is not None and status < 500 and status != 503:
-                    _M_PROXY_CANARY.inc()
-                    _M_PROXY_LATENCY.observe(time.perf_counter() - t0)
-                    self._reply(status, payload, headers=headers)
-                    return
-                # a failing canary never costs the client a response:
-                # fall back into the main pool.  The canary replica's
-                # own per-version error metrics carry the verdict
-                # evidence — the proxy only limits the blast radius.
-                _M_PROXY_RETRIES.inc()
-        tried_this_round: set = set()
-        unavailable_503 = 0
+        with srv._block:
+            srv._fwd_requests += 1
+        budget_ms = self._deadline_budget_ms()
+        budget_deadline = (tm0 + budget_ms / 1e3
+                           if budget_ms is not None else None)
+        deadline = tm0 + srv.retry_deadline_s
+        if budget_deadline is not None:
+            deadline = min(deadline, budget_deadline)
+        is_predict = (method == "POST"
+                      and self.path.partition("?")[0].startswith("/predict"))
+        if not srv.admit(deadline):
+            with srv._block:
+                srv._shed += 1
+            _M_PROXY_SHED.inc()
+            self._reply(503, (json.dumps(
+                {"error": "proxy overloaded, retry later"}) + "\n").encode(),
+                headers=[("Retry-After", "1")])
+            return
+        try:
+            if budget_deadline is not None \
+                    and time.monotonic() >= budget_deadline:
+                self._reply_deadline_exceeded(srv, 0)
+                return
+            if is_predict and self.path.partition("?")[0] == "/predict":
+                canary = srv.pick_canary()
+                if canary is not None:
+                    status = None
+                    try:
+                        status, headers, payload = self._try_backend(
+                            srv, canary, method, body,
+                            timeout_s=self._attempt_timeout(srv, deadline),
+                            deadline_ms=self._remaining_ms(budget_deadline))
+                    except (OSError, http.client.HTTPException):
+                        # a canary that stops answering must not be
+                        # re-picked and re-timed-out on every request
+                        # until the prober notices: eject it like a
+                        # main-pool backend
+                        srv.eject(canary)
+                    finally:
+                        srv.release(canary)
+                    if status is not None and status < 500 and status != 503:
+                        _M_PROXY_CANARY.inc()
+                        _M_PROXY_LATENCY.observe(time.perf_counter() - t0)
+                        self._reply(status, payload, headers=headers)
+                        return
+                    # a failing canary never costs the client a
+                    # response: fall back into the main pool.  The
+                    # canary replica's own per-version error metrics
+                    # carry the verdict evidence — the proxy only
+                    # limits the blast radius.
+                    _M_PROXY_RETRIES.inc()
+            self._forward_pool(srv, method, body, t0, deadline,
+                               budget_deadline, hedge_ok=is_predict)
+        finally:
+            srv.unadmit()
+
+    @staticmethod
+    def _attempt_timeout(srv: FleetProxy, deadline: float) -> float:
+        return min(srv.backend_timeout_s,
+                   max(deadline - time.monotonic(), 0.05))
+
+    @staticmethod
+    def _remaining_ms(budget_deadline: Optional[float]) -> Optional[float]:
+        if budget_deadline is None:
+            return None
+        return max(0.0, (budget_deadline - time.monotonic()) * 1e3)
+
+    def _reply_deadline_exceeded(self, srv: FleetProxy,
+                                 attempts: int) -> None:
+        with srv._block:
+            srv._deadline_rejected += 1
+        _M_PROXY_DEADLINE.inc()
+        self._reply_json(504, {"error": "deadline exhausted",
+                               "attempts": attempts})
+
+    def _forward_pool(self, srv: FleetProxy, method: str,
+                      body: Optional[bytes], t0: float, deadline: float,
+                      budget_deadline: Optional[float],
+                      hedge_ok: bool) -> None:
+        """The attempt loop: worker threads race into a result queue so
+        the handler can arm a hedge while the first attempt is still in
+        flight.  At most one hedge per request; every launched attempt
+        feeds the breaker when it eventually resolves."""
+        resultq: "queue.Queue" = queue.Queue()
+        tried: set = set()
+        busy: set = set()  # addrs with an attempt currently in flight
+        inflight = 0
         attempt = 0
-        while True:
-            backend = srv.pick(exclude=tried_this_round)
-            if backend is None:
-                if time.monotonic() > deadline:
-                    self._reply_json(502, {
-                        "error": "no healthy backend",
-                        "attempts": attempt,
-                    })
-                    return
-                time.sleep(0.05)
-                tried_this_round.clear()  # health loop may restore one
-                continue
+        hedge_used = False
+        last_503 = None
+
+        def launch(backend: _Backend, is_hedge: bool) -> None:
+            nonlocal inflight, attempt
             attempt += 1
-            try:
-                status, headers, payload = self._try_backend(
-                    srv, backend, method, body)
-            except (OSError, http.client.HTTPException):
-                srv.eject(backend)
-                tried_this_round.add(backend.addr)
-                _M_PROXY_RETRIES.inc()
+            inflight += 1
+            busy.add(backend.addr)
+            timeout_s = self._attempt_timeout(srv, deadline)
+            deadline_ms = self._remaining_ms(budget_deadline)
+            t_launch = time.monotonic()
+
+            def run():
+                # breaker feeding + ejection live HERE, in the attempt
+                # thread: a hung attempt whose handler already answered
+                # via hedge still lands its timeout on the breaker —
+                # that orphaned observation is exactly the gray-failure
+                # evidence the breaker exists to accumulate
+                try:
+                    out = self._try_backend(srv, backend, method, body,
+                                            timeout_s=timeout_s,
+                                            deadline_ms=deadline_ms)
+                    srv.note_result(backend,
+                                    time.monotonic() - t_launch,
+                                    ok=out[0] < 500)
+                    resultq.put((backend, is_hedge, t_launch, None, out))
+                except (OSError, http.client.HTTPException) as e:
+                    srv.note_result(backend,
+                                    time.monotonic() - t_launch, ok=False)
+                    srv.eject(backend)
+                    resultq.put((backend, is_hedge, t_launch, e, None))
+                finally:
+                    srv.release(backend)
+
+            threading.Thread(target=run, daemon=True,
+                             name="ltpu-fleet-attempt").start()
+
+        def give_up(now: float) -> None:
+            # the client's budget is spent (attempts may still be in
+            # flight) — answer now, bounded: the best 503 we saw, a 504
+            # for an exhausted client deadline, a 502 otherwise
+            if last_503 is not None:
+                status, headers, payload = last_503
+                self._reply(status, payload, headers=headers)
+            elif budget_deadline is not None and now >= budget_deadline:
+                self._reply_deadline_exceeded(srv, attempt)
+            else:
+                self._reply_json(502, {
+                    "error": "no backend answered before the retry "
+                             "deadline", "attempts": attempt})
+
+        while True:
+            if inflight == 0:
                 if time.monotonic() > deadline:
-                    self._reply_json(502, {
-                        "error": "no backend answered before the retry "
-                                 "deadline", "attempts": attempt})
+                    give_up(time.monotonic())
                     return
-                continue
-            finally:
-                srv.release(backend)
-            if status == 503 and unavailable_503 < len(srv.backends):
-                # draining/overloaded replica: give the others a shot,
-                # but relay the 503 once every backend said it
-                unavailable_503 += 1
-                tried_this_round.add(backend.addr)
-                _M_PROXY_RETRIES.inc()
-                if time.monotonic() <= deadline:
+                backend = srv.pick(exclude=tried)
+                if backend is None:
+                    time.sleep(0.05)
+                    tried.clear()  # health loop may restore one
                     continue
+                launch(backend, is_hedge=False)
+            # wait for a result; while the FIRST attempt is alone in
+            # flight an un-hedged predict wakes early at the hedge delay
+            wait_s = max(deadline - time.monotonic(), 0.001)
+            hd = srv.hedge_delay_s() if (hedge_ok and not hedge_used
+                                         and inflight == 1) else None
+            if hd is not None:
+                wait_s = min(wait_s, hd)
+            try:
+                backend, is_hedge, t_launch, err, out = resultq.get(
+                    timeout=wait_s)
+            except queue.Empty:
+                now = time.monotonic()
+                if now > deadline:
+                    give_up(now)
+                    return
+                if hd is not None and not hedge_used:
+                    hedge_used = True  # one hedge per request, ever
+                    if srv.take_hedge_token():
+                        # a hedge at the backend the stuck attempt is
+                        # already on is no hedge at all: exclude busy
+                        # addrs, and skip entirely if pick's all-healthy
+                        # fallback re-includes one (hung single-survivor
+                        # fleets just wait out the first attempt)
+                        hb = srv.pick(exclude=tried | busy)
+                        if hb is not None and hb.addr in busy:
+                            srv.release(hb)
+                        elif hb is not None:
+                            _M_PROXY_HEDGES.inc()
+                            launch(hb, is_hedge=True)
+                continue
+            inflight -= 1
+            busy.discard(backend.addr)
+            if err is not None:
+                tried.add(backend.addr)
+                _M_PROXY_RETRIES.inc()
+                continue
+            status, headers, payload = out
+            if status == 503:
+                tried.add(backend.addr)
+                last_503 = (status, headers, payload)
+                if srv.has_untried(tried) and time.monotonic() <= deadline:
+                    # draining/overloaded replica: give the others a
+                    # shot, but relay the 503 once every backend
+                    # actually tried this round said it
+                    _M_PROXY_RETRIES.inc()
+                    continue
+                if inflight > 0:
+                    continue  # a raced attempt may still answer
+            elif is_hedge:
+                with srv._block:
+                    srv._hedge_wins += 1
+                _M_PROXY_HEDGE_WINS.inc()
             _M_PROXY_LATENCY.observe(time.perf_counter() - t0)
             self._reply(status, payload, headers=headers)
             return
 
     def _try_backend(self, srv: FleetProxy, backend: _Backend,
-                     method: str, body: Optional[bytes]):
+                     method: str, body: Optional[bytes],
+                     timeout_s: Optional[float] = None,
+                     deadline_ms: Optional[float] = None):
         conn = http.client.HTTPConnection(
-            backend.host, backend.port, timeout=srv.backend_timeout_s)
+            backend.host, backend.port,
+            timeout=timeout_s if timeout_s else srv.backend_timeout_s)
+        headers = {"Content-Type": "application/json"}
+        if deadline_ms is not None:
+            # each hop forwards the SHRUNKEN remainder: the replica sees
+            # how much of the client's budget is actually left
+            headers["X-Deadline-Ms"] = str(int(deadline_ms))
         try:
-            conn.request(method, self.path, body=body,
-                         headers={"Content-Type": "application/json"})
+            conn.request(method, self.path, body=body, headers=headers)
             resp = conn.getresponse()
             payload = resp.read()
             return resp.status, resp.getheaders(), payload
@@ -545,6 +908,14 @@ FLEET_DEFAULTS = {
     "health_poll_ms": 500,
     "retry_deadline_ms": 10000,
     "ready_timeout_ms": 120000,
+    "backend_timeout_ms": 30000,
+    "hedge_delay_ms": 0.0,       # 0 = adaptive p95; <0 disables hedging
+    "hedge_budget_pct": 10.0,
+    "breaker_k": 3.0,
+    "breaker_m": 5,
+    "breaker_open_ms": 2000,
+    "max_concurrent": 128,
+    "max_queue": 256,
 }
 
 
@@ -583,15 +954,25 @@ def _wait_ready(host: str, port: int, timeout_s: float) -> bool:
 
 def spawn_replicas(n: int, serve_params: Dict[str, str],
                    ports: Optional[List[int]] = None,
-                   host: str = "127.0.0.1") -> List[Tuple[subprocess.Popen, int]]:
-    """Launch ``n`` ``python -m lightgbm_tpu serve`` subprocesses."""
+                   host: str = "127.0.0.1",
+                   envs: Optional[List[Optional[Dict[str, str]]]] = None,
+                   ) -> List[Tuple[subprocess.Popen, int]]:
+    """Launch ``n`` ``python -m lightgbm_tpu serve`` subprocesses.
+
+    ``envs[i]`` overlays extra environment onto replica ``i`` — how the
+    chaos harness and bench arm per-replica fault injection
+    (``LIGHTGBM_TPU_SERVE_FAULT``) without touching the shared argv."""
     ports = ports or _free_ports(n, host)
     procs = []
-    for port in ports[:n]:
+    for i, port in enumerate(ports[:n]):
         argv = [sys.executable, "-m", "lightgbm_tpu", "serve",
                 f"host={host}", f"port={port}"]
         argv += [f"{k}={v}" for k, v in serve_params.items()]
-        procs.append((subprocess.Popen(argv), port))
+        env = None
+        if envs and i < len(envs) and envs[i]:
+            env = dict(os.environ)
+            env.update(envs[i])
+        procs.append((subprocess.Popen(argv, env=env), port))
     return procs
 
 
@@ -628,7 +1009,10 @@ def main(argv: List[str]) -> int:
             k: v for k, v in params.items()
             if k not in ("host", "port", "replicas", "base_port", "policy",
                          "backends", "health_poll_ms", "retry_deadline_ms",
-                         "ready_timeout_ms")
+                         "ready_timeout_ms", "backend_timeout_ms",
+                         "hedge_delay_ms", "hedge_budget_pct", "breaker_k",
+                         "breaker_m", "breaker_open_ms", "max_concurrent",
+                         "max_queue")
         }
         n = int(opts["replicas"])
         ports = (list(range(int(opts["base_port"]),
@@ -648,8 +1032,16 @@ def main(argv: List[str]) -> int:
 
     proxy = FleetProxy(
         (host, int(opts["port"])), backends, policy=policy,
+        backend_timeout_s=float(opts["backend_timeout_ms"]) / 1e3,
         health_poll_s=float(opts["health_poll_ms"]) / 1e3,
         retry_deadline_s=float(opts["retry_deadline_ms"]) / 1e3,
+        hedge_delay_ms=float(opts["hedge_delay_ms"]),
+        hedge_budget_pct=float(opts["hedge_budget_pct"]),
+        breaker_k=float(opts["breaker_k"]),
+        breaker_m=int(opts["breaker_m"]),
+        breaker_open_ms=float(opts["breaker_open_ms"]),
+        max_concurrent=int(opts["max_concurrent"]),
+        max_queue=int(opts["max_queue"]),
     )
     bound = proxy.server_address[1]
     Log.info("fleet: proxy listening on http://%s:%d over %d backend(s)",

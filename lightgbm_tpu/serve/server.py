@@ -26,7 +26,14 @@ Endpoints:
                      balancer keys traffic on.
   GET  /stats        serving metrics: batcher counters + latency
                      quantiles, bucket-cache compile accounting, queue
-                     depth, readiness/drain state, uptime.
+                     depth, readiness/drain state, registry staleness,
+                     uptime.
+  POST /fault        chaos drills (serve/faults.py): (re)arm serving
+                     fault injection at runtime — ``{"spec":
+                     "hang:1"}`` — an empty spec clears it; GET /fault
+                     reports the armed spec + per-kind injection counts.
+                     ``LIGHTGBM_TPU_SERVE_FAULT`` arms the same grammar
+                     at startup.
   GET  /metrics      the same signals in Prometheus text format
                      (obs/metrics.py): request/shed/deadline counters,
                      batch-size + latency histograms, queue depth,
@@ -41,7 +48,10 @@ new ``/predict`` requests get 503, in-flight microbatches finish
 Each HTTP request becomes one ``MicroBatcher.submit`` call, so
 concurrent requests coalesce into shared device batches; an overloaded
 queue answers 503 and an expired request deadline 504 (shed-not-queue,
-see batcher.py).
+see batcher.py).  A client (or proxy) ``X-Deadline-Ms`` header bounds
+the request end to end: a spent budget 504s before any device work and
+a live one caps the batcher queue wait at
+``min(request_timeout_ms, remaining budget)``.
 
 Startup: ``model=`` accepts either a packed ``.npz`` artifact
 (serve/artifact.py) or a reference-format model text file, which is
@@ -73,6 +83,7 @@ import numpy as np
 from ..obs import compilewatch, tracer
 from ..obs.metrics import registry as metrics_registry
 from ..utils.log import LightGBMError, Log
+from . import faults
 from .artifact import PackedPredictor, PredictorArtifact
 from .batcher import MicroBatcher, RequestTimeout, ServerOverloaded
 from .fleet import SwappablePredictor
@@ -125,6 +136,12 @@ _M_ROUTE_LATENCY = metrics_registry.labeled_histogram(
 _M_ADMISSION_REFUSED = metrics_registry.counter(
     "lightgbm_tpu_serve_admission_refused_total",
     "route admissions refused by the device-bytes budget")
+_M_DEADLINE_REJECTED = metrics_registry.counter(
+    "lightgbm_tpu_serve_deadline_rejected_total",
+    "predicts 504ed because the X-Deadline-Ms budget was already spent")
+_M_FAULTS_INJECTED = metrics_registry.counter(
+    "lightgbm_tpu_serve_fault_injected_total",
+    "requests wounded by LIGHTGBM_TPU_SERVE_FAULT / POST /fault")
 
 _DEFAULT_ROUTE = "default"
 
@@ -282,6 +299,13 @@ class PredictServer(ThreadingHTTPServer):
             "lightgbm_tpu_serve_uptime_seconds",
             "seconds since this server process started serving",
             fn=lambda: time.time() - self.t_start)
+        # registry-staleness degradation (docs/ROBUSTNESS.md): a replica
+        # whose swaps keep failing serves last-good FOREVER — correct,
+        # but it must be visible, and the factory refuses to promote
+        # against it (factory/supervisor.py _fleet_fresh)
+        self._registry_stale_lock = threading.Lock()
+        self._registry_stale_since: Optional[float] = None
+        self._registry_failures = 0
         if registry is not None:
             # scrape-time registry views: a manifest read is host-side
             # file I/O only (never jax), cheap enough per scrape
@@ -293,7 +317,36 @@ class PredictServer(ThreadingHTTPServer):
                 "lightgbm_tpu_registry_active_version",
                 "version the registry manifest currently activates",
                 fn=lambda: float(registry.active_version() or 0))
+            metrics_registry.gauge(
+                "lightgbm_tpu_serve_registry_stale_seconds",
+                "seconds since registry swaps started failing on this "
+                "replica (0 = fresh)",
+                fn=lambda: self.registry_stale_seconds())
         super().__init__(addr, _Handler)
+
+    # -- registry staleness --------------------------------------------
+    def registry_stale_seconds(self) -> float:
+        with self._registry_stale_lock:
+            if self._registry_stale_since is None:
+                return 0.0
+            return max(0.0, time.monotonic() - self._registry_stale_since)
+
+    def _registry_sync_failed(self, err: Exception) -> None:
+        with self._registry_stale_lock:
+            self._registry_failures += 1
+            n = self._registry_failures
+            if self._registry_stale_since is None:
+                self._registry_stale_since = time.monotonic()
+        tracer.event("serve.registry_stale", consecutive_failures=n,
+                     error=f"{type(err).__name__}: {err}")
+
+    def _registry_sync_ok(self) -> None:
+        with self._registry_stale_lock:
+            was_stale = self._registry_stale_since is not None
+            self._registry_stale_since = None
+            self._registry_failures = 0
+        if was_stale:
+            Log.info("serve: registry sync recovered (fresh again)")
 
     # -- registry / hot swap -------------------------------------------
     def maybe_swap(self) -> Optional[Dict]:
@@ -413,19 +466,26 @@ class PredictServer(ThreadingHTTPServer):
                 if t == token:
                     continue
                 token = t
+                failed = None
                 try:
                     self.maybe_swap()
                 except Exception as e:
                     # a torn publish or corrupt artifact must not kill
                     # the serving loop — keep the current model and retry
                     # on the next token change
+                    failed = e
                     Log.warning("serve: registry swap failed (still on "
                                 "v%s): %s", getattr(self.predictor,
                                                     "version", "?"), e)
                 try:
                     self.sync_routes()
                 except Exception as e:
+                    failed = e
                     Log.warning("serve: route sync failed: %s", e)
+                if failed is None:
+                    self._registry_sync_ok()
+                else:
+                    self._registry_sync_failed(failed)
 
         self._watch_thread = threading.Thread(
             target=_loop, name="ltpu-registry-watch", daemon=True)
@@ -578,11 +638,18 @@ class PredictServer(ThreadingHTTPServer):
                 "last": self.predictor.last_swap,
             }
         if self.registry is not None:
+            with self._registry_stale_lock:
+                failures = self._registry_failures
             out["registry"] = {
                 "dir": self.registry.dir,
                 "active_version": self.registry.active_version(),
                 "models": len(self.registry.read_manifest()["entries"]),
+                "stale_seconds": round(self.registry_stale_seconds(), 3),
+                "consecutive_failures": failures,
             }
+        fault = faults.counters()
+        if fault["spec"]:
+            out["fault"] = fault
         return out
 
     def shutdown(self):
@@ -643,6 +710,8 @@ class _Handler(BaseHTTPRequestHandler):
                 })
         elif self.path == "/routes":
             self._do_routes_get()
+        elif self.path == "/fault":
+            self._reply_json(200, faults.counters())
         elif self.path == "/metrics":
             # Prometheus text format; render() never touches jax, so a
             # scrape storm cannot compile or serialize device work
@@ -659,6 +728,9 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/routes":
             self._do_routes_post()
             return
+        if path == "/fault":
+            self._do_fault()
+            return
         route = None
         if path.startswith("/predict/"):
             route = path[len("/predict/"):]
@@ -670,11 +742,53 @@ class _Handler(BaseHTTPRequestHandler):
             # flip; anything still arriving is told to go elsewhere
             self._reply_json(503, {"error": "server is draining"})
             return
+        # serving fault injection (serve/faults.py): wound the request
+        # BEFORE inflight tracking so a hung drill never wedges a drain;
+        # admin endpoints above stay exempt so a chaos test can always
+        # clear the fault it armed
+        act = faults.action()
+        if act is not None:
+            _M_FAULTS_INJECTED.inc()
+            tracer.event("serve.fault", kind=act[0])
+            if act[0] == "hang":
+                # the canonical gray failure: the connection stays open,
+                # /readyz stays 200, no response ever comes (bounded
+                # only so the daemon thread eventually dies in tests)
+                time.sleep(3600.0)
+                return
+            if act[0] == "error":
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    self.rfile.read(length)  # keep the connection parseable
+                self._count_error(route)
+                self._reply_json(500, {"error": "injected serve fault"})
+                return
+            if act[0] == "delay":
+                time.sleep(act[1] / 1e3)
         self.server.track_begin()
         try:
             self._do_predict(query, route=route)
         finally:
             self.server.track_end()
+
+    def _do_fault(self) -> None:
+        """POST /fault {"spec": "hang:1,..."} — (re)arm serving fault
+        injection at runtime; an empty spec clears it.  The chaos
+        harness measures a healthy baseline on a fleet, then wounds the
+        very same replicas through this endpoint."""
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(length) or b"{}")
+            spec = str(body.get("spec") or "")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._reply_json(400, {"error": f"bad request body: {e}"})
+            return
+        try:
+            armed = faults.set_spec(spec)
+        except ValueError as e:
+            self._reply_json(400, {"error": str(e)})
+            return
+        self._reply_json(200, {"spec": armed})
 
     def _do_routes_get(self) -> None:
         """GET /routes: the live route table (what THIS replica serves)
@@ -781,6 +895,23 @@ class _Handler(BaseHTTPRequestHandler):
                              _DEFAULT_ROUTE).inc()
 
     def _do_predict(self, query: str, route: Optional[str] = None) -> None:
+        # deadline propagation: the proxy forwards the SHRUNKEN client
+        # budget in X-Deadline-Ms; a spent budget 504s before any row
+        # parsing or device work, and a live one bounds the batcher wait
+        t_arrive = time.monotonic()
+        budget_ms: Optional[float] = None
+        raw_budget = self.headers.get("X-Deadline-Ms")
+        if raw_budget:
+            try:
+                budget_ms = float(raw_budget)
+            except ValueError:
+                budget_ms = None
+        if budget_ms is not None and budget_ms <= 0:
+            _M_DEADLINE_REJECTED.inc()
+            self._count_error(route)
+            self._reply_json(504, {"error": "deadline exhausted before "
+                                            "any device work"})
+            return
         raw_score = "raw_score=1" in query
         stamp_version = "model_version=1" in query
         if route is None:
@@ -808,8 +939,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply_json(400, {"error": str(e)})
             return
         t0 = time.monotonic()
+        timeout_ms: Optional[float] = None
+        if budget_ms is not None:
+            remaining = budget_ms - (time.monotonic() - t_arrive) * 1e3
+            # the batcher queue wait takes min(local timeout, remaining
+            # budget); an already-spent budget fast-fails inside _submit
+            timeout_ms = min(float(batcher.request_timeout_ms), remaining)
         try:
-            preds, version = batcher.submit_ex(rows)
+            preds, version = batcher.submit_ex(rows, timeout_ms=timeout_ms)
         except ServerOverloaded as e:
             self._count_error(route)
             self._reply_json(503, {"error": str(e)})
@@ -911,6 +1048,7 @@ def main(argv: List[str]) -> int:
     from ..cli import parse_argv
 
     tracer.refresh_from_env()
+    faults.refresh_from_env()  # LIGHTGBM_TPU_SERVE_FAULT chaos drills
     params = parse_argv(argv)
     model_path = params.get("model") or params.get("input_model")
     registry_dir = params.get("registry")

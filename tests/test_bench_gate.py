@@ -515,3 +515,67 @@ def test_spot_section_error_never_gates(tmp_path):
     assert bench.apply_regression_gate(out, bench_dir=str(tmp_path),
                                        env={}) == 0
     assert "gate_spot" not in out
+
+
+def _serving_tail(hedged_ratio=1.7):
+    return {
+        "requests_per_leg": 90, "injected_delay_ms": 300.0,
+        "hedge_delay_ms": 25.0, "gate_floor_ms": 20.0,
+        "healthy_p99_ms": 8.1, "unhedged_chaos_p99_ms": 305.0,
+        "hedged_chaos_p99_ms": round(20.0 * hedged_ratio, 2),
+        "unhedged_chaos_over_healthy_p99": 15.25,
+        "hedged_chaos_over_healthy_p99": hedged_ratio,
+        "hedges_launched": 3, "hedge_wins": 3,
+    }
+
+
+def test_serving_tail_gate_fires_without_prior(tmp_path):
+    """Hedged p99 under an injected-delay replica must stay <= 3x the
+    healthy baseline; the contract is protocol-level, so the leg gates
+    outright with no prior capture."""
+    out = {"metric": METRIC, "value": 0.10,
+           "serving_tail": _serving_tail(hedged_ratio=4.2)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path),
+                                       env={}) == 1
+    assert out["regression_serving_tail"] is True
+    assert out["gate_serving_tail"][
+        "max_hedged_chaos_over_healthy_p99"] == 3.0
+    assert out["gate_serving_tail"][
+        "hedged_chaos_over_healthy_p99"] == pytest.approx(4.2)
+
+
+def test_serving_tail_gate_is_device_independent(tmp_path):
+    # the ratio gates even on a backend_fallback capture that skips
+    # every wall-clock gate (the injected delay dominates any backend)
+    out = {"metric": METRIC, "value": 9.9, "backend_fallback": True,
+           "serving_tail": _serving_tail(hedged_ratio=3.5)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path),
+                                       env={}) == 1
+    assert out["regression_serving_tail"] is True
+    assert "regression" not in out  # headline leg still skipped
+    out = {"metric": METRIC, "value": 9.9, "backend_fallback": True,
+           "serving_tail": _serving_tail(hedged_ratio=1.5)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path),
+                                       env={}) == 0
+    assert "gate_serving_tail" in out
+
+
+def test_serving_tail_gate_passes(tmp_path):
+    out = {"metric": METRIC, "value": 0.10,
+           "serving_tail": _serving_tail(hedged_ratio=1.66)}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path),
+                                       env={}) == 0
+    assert out["gate_serving_tail"][
+        "hedged_chaos_over_healthy_p99"] == pytest.approx(1.66)
+    for k in list(out):
+        assert not k.startswith("regression"), k
+
+
+def test_serving_tail_section_error_never_gates(tmp_path):
+    out = {"metric": METRIC, "value": 0.10,
+           "serving_tail": {"error": "RuntimeError: replica never ready",
+                            "hedged_chaos_over_healthy_p99": 9.9}}
+    assert bench.apply_regression_gate(out, bench_dir=str(tmp_path),
+                                       env={}) == 0
+    assert "gate_serving_tail" not in out
+    assert "regression_serving_tail" not in out

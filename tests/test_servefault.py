@@ -1,0 +1,975 @@
+"""Serving gray-failure drills (docs/ROBUSTNESS.md serving table).
+
+Unit legs: the ``LIGHTGBM_TPU_SERVE_FAULT`` grammar, the latency-outlier
+circuit breaker state machine, deadline propagation (header shrinks hop
+by hop; a spent budget 504s before any device work), hedged requests
+(rescue + budget), proxy overload shed with ``Retry-After``, the canary
+connection-failure ejection, the 503 re-route tried-set bound, and
+registry-staleness surfacing plus the factory's refusal to promote
+against a stale fleet.
+
+Chaos leg (tier-1, ``servefault`` marker): a 3-replica fleet under live
+closed-loop traffic takes one hung replica, one delay-injected replica,
+and one SIGKILL at once — zero dropped, zero mis-versioned responses,
+bounded client p99, and the breaker observed OPEN then restored
+HALF_OPEN -> CLOSED once the fault clears.  The sustained flap matrix is
+additionally marked slow.
+"""
+
+import json
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import compilewatch
+from lightgbm_tpu.obs.metrics import RollingQuantile
+from lightgbm_tpu.serve import (
+    FleetProxy,
+    ModelRegistry,
+    PackedPredictor,
+    PredictorArtifact,
+)
+from lightgbm_tpu.serve import breaker as breaker_mod
+from lightgbm_tpu.serve import faults
+from lightgbm_tpu.serve.batcher import MicroBatcher, RequestTimeout
+
+
+@pytest.fixture(scope="module")
+def binary_booster():
+    rng = np.random.RandomState(3)
+    X = rng.randn(600, 12)
+    y = (X[:, 0] + 0.5 * X[:, 1] - X[:, 2] ** 2 > -0.5).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, params={"min_data_in_leaf": 5})
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 15, "verbose": -1},
+        ds, num_boost_round=12, verbose_eval=False,
+    )
+    return bst, X
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_spec():
+    """In-process tests share the faults module's globals with any
+    in-process server — always leave the spec disarmed."""
+    faults.set_spec("")
+    yield
+    faults.set_spec("")
+
+
+# ----------------------------------------------------------------------
+# fault-spec grammar (serve/faults.py)
+# ----------------------------------------------------------------------
+class TestFaultSpecGrammar:
+    def test_parse_clauses(self):
+        assert faults.parse_serve_fault_spec("hang:3") == [("hang", 3)]
+        assert faults.parse_serve_fault_spec("error:1") == [("error", 1)]
+        assert faults.parse_serve_fault_spec("delay:250") == \
+            [("delay", 250.0, 1.0)]
+        assert faults.parse_serve_fault_spec("delay:250:0.25") == \
+            [("delay", 250.0, 0.25)]
+        assert faults.parse_serve_fault_spec("flap:1.5") == [("flap", 1.5)]
+        assert faults.parse_serve_fault_spec("delay:10:0.5,hang:9") == \
+            [("delay", 10.0, 0.5), ("hang", 9)]
+        assert faults.parse_serve_fault_spec("") == []
+        assert faults.parse_serve_fault_spec(None) == []
+
+    @pytest.mark.parametrize("bad", [
+        "hang", "hang:x", "error:one", "delay:-5", "delay:10:0",
+        "delay:10:1.5", "flap:0", "flap:-1", "bogus:1", "hang:1:2",
+    ])
+    def test_bad_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            faults.parse_serve_fault_spec(bad)
+
+    def test_bad_env_spec_warns_and_stays_off(self, monkeypatch):
+        monkeypatch.setenv(faults.ENV_VAR, "bogus:spec")
+        faults.refresh_from_env()
+        assert faults.counters()["spec"] == ""
+        assert faults.action() is None
+
+    def test_error_clause_fires_from_nth_request(self):
+        faults.set_spec("error:3")
+        assert faults.action() is None
+        assert faults.action() is None
+        assert faults.action() == ("error",)
+        assert faults.action() == ("error",)
+        c = faults.counters()
+        assert c["spec"] == "error:3"
+        assert c["requests_seen"] == 4
+        assert c["injected"] == {"error": 2}
+
+    def test_delay_fraction_is_deterministic(self):
+        faults.set_spec("delay:40:0.5")
+        fired = [faults.action() for _ in range(10)]
+        hits = [a for a in fired if a is not None]
+        assert len(hits) == 5  # exactly frac of requests, no RNG
+        assert all(a == ("delay", 40.0) for a in hits)
+
+    def test_clear_disarms(self):
+        faults.set_spec("error:1")
+        assert faults.action() == ("error",)
+        assert faults.set_spec("") == ""
+        assert faults.action() is None
+        assert faults.counters()["requests_seen"] == 0
+
+    def test_flap_alternates_on_wall_clock(self):
+        faults.set_spec("flap:0.3")
+        assert faults.action() == ("hang",)  # hang phase first
+        time.sleep(0.35)
+        assert faults.action() is None  # healthy phase
+        time.sleep(0.35)
+        assert faults.action() == ("hang",)
+
+
+# ----------------------------------------------------------------------
+# rolling p95 window (obs/metrics.py) — the adaptive hedge trigger
+# ----------------------------------------------------------------------
+class TestRollingQuantile:
+    def test_quantiles_and_window(self):
+        rq = RollingQuantile(window=100)
+        assert rq.quantile(0.95) == 0.0  # empty
+        for v in range(1, 101):
+            rq.observe(float(v))
+        assert rq.count() == 100
+        assert rq.quantile(0.0) == 1.0
+        assert rq.quantile(0.95) == 96.0
+        for _ in range(100):  # old values roll out of the window
+            rq.observe(1000.0)
+        assert rq.quantile(0.5) == 1000.0
+
+
+# ----------------------------------------------------------------------
+# latency-outlier circuit breaker (serve/breaker.py)
+# ----------------------------------------------------------------------
+class TestLatencyBreaker:
+    def test_opens_on_latency_outlier_vs_fleet_median(self):
+        b = breaker_mod.LatencyBreaker(k=3.0, m=3, open_s=60.0)
+        for addr in ("a", "b", "c"):
+            for _ in range(4):
+                assert b.observe(addr, 0.01, ok=True) is None
+        # one backend drifts to 100x the fleet median: m hot obs trip it
+        assert b.observe("d", 1.0, ok=True) is None
+        assert b.observe("d", 1.0, ok=True) is None
+        assert b.observe("d", 1.0, ok=True) == "open"
+        assert b.state("d") == breaker_mod.OPEN
+        assert b.open_count() == 1
+        assert b.state("a") == breaker_mod.CLOSED
+
+    def test_opens_on_consecutive_errors(self):
+        b = breaker_mod.LatencyBreaker(k=3.0, m=2, open_s=60.0)
+        assert b.observe("x", 0.01, ok=False) is None
+        assert b.observe("x", 0.01, ok=False) == "open"
+        assert b.snapshot()["x"]["opens"] == 1
+
+    def test_half_open_probe_close_and_reopen(self):
+        b = breaker_mod.LatencyBreaker(k=3.0, m=2, open_s=0.05)
+        for addr in ("a", "b", "c"):
+            b.observe(addr, 0.01, ok=True)
+        b.observe("x", 0.01, ok=False)
+        assert b.observe("x", 0.01, ok=False) == "open"
+        assert not b.trial_eligible("x")  # cooldown not yet served
+        time.sleep(0.07)
+        assert b.trial_eligible("x")
+        b.begin_attempt("x")
+        assert b.state("x") == breaker_mod.HALF_OPEN
+        assert not b.trial_eligible("x")  # single trial slot claimed
+        # good probe closes — judged on the probe's own latency, not the
+        # failure-poisoned EWMA — and re-enters with fresh stats
+        assert b.observe("x", 0.012, ok=True) == "close"
+        snap = b.snapshot()["x"]
+        assert snap["state"] == breaker_mod.CLOSED
+        assert snap["ewma_ms"] == pytest.approx(12.0)
+        # trip again; a failing probe re-opens for another cooldown
+        b.observe("x", 0.01, ok=False)
+        assert b.observe("x", 0.01, ok=False) == "open"
+        time.sleep(0.07)
+        b.begin_attempt("x")
+        assert b.observe("x", 0.01, ok=False) == "reopen"
+        assert b.state("x") == breaker_mod.OPEN
+        assert b.snapshot()["x"]["opens"] == 3
+
+    def test_good_observation_resets_hot_streak(self):
+        b = breaker_mod.LatencyBreaker(k=3.0, m=3, open_s=60.0)
+        b.observe("x", 0.01, ok=False)
+        b.observe("x", 0.01, ok=False)
+        b.observe("x", 0.01, ok=True)  # streak broken
+        assert b.observe("x", 0.01, ok=False) is None
+        assert b.state("x") == breaker_mod.CLOSED
+
+
+# ----------------------------------------------------------------------
+# proxy-side drills against in-process fake backends (no jax)
+# ----------------------------------------------------------------------
+class _FaultyBackend:
+    """Replica double: /readyz 200 always (the gray-failure signature),
+    /predict optionally delayed; records every X-Deadline-Ms it sees."""
+
+    def __init__(self, version=1, delay_s=0.0):
+        fake = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = b"{}\n"
+                self.send_response(200 if self.path == "/readyz" else 404)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                self.rfile.read(n)
+                with fake.lock:
+                    fake.deadlines.append(
+                        self.headers.get("X-Deadline-Ms"))
+                if fake.delay_s > 0:
+                    time.sleep(fake.delay_s)
+                body = b"0.5\n"
+                self.send_response(200)
+                self.send_header("X-Model-Version", str(fake.version))
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.version = version
+        self.delay_s = delay_s
+        self.lock = threading.Lock()
+        self.deadlines = []
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self.addr = f"127.0.0.1:{self.port}"
+        self._t = threading.Thread(target=self.httpd.serve_forever,
+                                   daemon=True)
+        self._t.start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _start_proxy(backends, **kw):
+    kw.setdefault("health_poll_s", 0.1)
+    kw.setdefault("retry_deadline_s", 5.0)
+    proxy = FleetProxy(("127.0.0.1", 0), [b.addr for b in backends], **kw)
+    t = threading.Thread(target=proxy.serve_forever, daemon=True)
+    t.start()
+    return proxy, proxy.server_address[1]
+
+
+def _proxy_predict(port, deadline_ms=None, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict", data=b"[1.0, 2.0]\n")
+    if deadline_ms is not None:
+        req.add_header("X-Deadline-Ms", str(deadline_ms))
+    r = urllib.request.urlopen(req, timeout=timeout)
+    return r.status, r.headers.get("X-Model-Version")
+
+
+def _proxy_stats(port):
+    return json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/fleet/stats", timeout=30).read())
+
+
+class TestDeadlinePropagation:
+    def test_budget_forwarded_shrunken_to_backend(self):
+        backends = [_FaultyBackend()]
+        proxy, port = _start_proxy(backends)
+        try:
+            status, _ = _proxy_predict(port, deadline_ms=5000)
+            assert status == 200
+            status, _ = _proxy_predict(port)  # no budget: no header
+            assert status == 200
+            seen = backends[0].deadlines
+            assert len(seen) == 2
+            assert seen[0] is not None
+            assert 0 < float(seen[0]) <= 5000  # hop subtracted elapsed
+            assert seen[1] is None
+        finally:
+            proxy.shutdown()
+            proxy.server_close()
+            backends[0].stop()
+
+    def test_spent_budget_is_bounded_504_not_backend_timeout(self):
+        """A 200 ms client budget against a 500 ms backend costs ~the
+        budget, never the 30 s backend socket timeout."""
+        backends = [_FaultyBackend(delay_s=0.5)]
+        proxy, port = _start_proxy(backends)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _proxy_predict(port, deadline_ms=200)
+            elapsed = time.monotonic() - t0
+            assert ei.value.code == 504
+            assert "deadline" in json.loads(ei.value.read())["error"]
+            assert elapsed < 2.0
+            assert _proxy_stats(port)["deadline_rejected"] >= 1
+        finally:
+            proxy.shutdown()
+            proxy.server_close()
+            backends[0].stop()
+
+    def test_batcher_fails_fast_on_spent_budget(self):
+        calls = []
+
+        def predict(batch):
+            calls.append(batch.shape[0])
+            return batch[:, 0]
+
+        b = MicroBatcher(predict, max_delay_ms=1.0)
+        try:
+            with pytest.raises(RequestTimeout):
+                b.submit(np.ones((2, 3)), timeout_ms=0.0)
+            with pytest.raises(RequestTimeout):
+                b.submit(np.ones((2, 3)), timeout_ms=-15.0)
+            assert b.stats()["timeouts"] == 2
+            assert calls == []  # no queue slot, no device work
+            assert np.allclose(
+                b.submit(np.ones((2, 3)), timeout_ms=500.0), [1.0, 1.0])
+        finally:
+            b.close()
+
+
+class TestHedgedRequests:
+    def test_hedge_rescues_slow_backend(self):
+        slow = _FaultyBackend(version=1, delay_s=0.8)
+        fast = _FaultyBackend(version=2)
+        proxy, port = _start_proxy([slow, fast], policy="rr",
+                                   hedge_delay_ms=50.0,
+                                   hedge_budget_pct=100.0)
+        try:
+            t0 = time.monotonic()
+            for _ in range(8):
+                status, _ = _proxy_predict(port)
+                assert status == 200
+            # unhedged, ~half the requests would cost 0.8 s each (>3 s
+            # total); the hedge turns a slow first pick into ~50 ms
+            assert time.monotonic() - t0 < 3.0
+            st = _proxy_stats(port)
+            assert st["hedges"]["launched"] >= 1
+            assert st["hedges"]["wins"] >= 1
+        finally:
+            proxy.shutdown()
+            proxy.server_close()
+            slow.stop()
+            fast.stop()
+
+    def test_hedge_never_targets_the_inflight_backend(self):
+        """With the slow backend already holding the first attempt, the
+        hedge must land on the other backend — a hedge at the stuck
+        backend is no hedge at all."""
+        slow = _FaultyBackend(version=1, delay_s=0.6)
+        fast = _FaultyBackend(version=2)
+        proxy, port = _start_proxy([slow, fast], policy="rr",
+                                   hedge_delay_ms=40.0,
+                                   hedge_budget_pct=100.0)
+        try:
+            for _ in range(6):
+                _proxy_predict(port)
+            st = _proxy_stats(port)
+            hedged = st["hedges"]["launched"]
+            assert hedged >= 1
+            # every hedge went to the fast backend and won there
+            assert st["hedges"]["wins"] == hedged
+        finally:
+            proxy.shutdown()
+            proxy.server_close()
+            slow.stop()
+            fast.stop()
+
+    def test_hedge_knobs(self):
+        a, b = _FaultyBackend(), _FaultyBackend()
+        proxy, _ = _start_proxy([a, b], hedge_delay_ms=-1.0)
+        try:
+            assert proxy.hedge_delay_s() is None  # negative disables
+            proxy.hedge_delay_ms = 75.0
+            assert proxy.hedge_delay_s() == pytest.approx(0.075)
+            proxy.hedge_delay_ms = 0.0  # adaptive: cold fallback first
+            assert proxy.hedge_delay_s() == pytest.approx(0.05)
+            for _ in range(40):
+                proxy._lat_window.observe(0.2)
+            assert proxy.hedge_delay_s() == pytest.approx(0.2)
+        finally:
+            proxy.shutdown()
+            proxy.server_close()
+            a.stop()
+            b.stop()
+
+    def test_single_backend_fleet_never_hedges(self):
+        a = _FaultyBackend()
+        proxy, _ = _start_proxy([a], hedge_delay_ms=50.0)
+        try:
+            assert proxy.hedge_delay_s() is None
+        finally:
+            proxy.shutdown()
+            proxy.server_close()
+            a.stop()
+
+    def test_hedge_budget_caps_volume(self):
+        a, b = _FaultyBackend(), _FaultyBackend()
+        proxy, _ = _start_proxy([a, b], hedge_budget_pct=10.0)
+        try:
+            # floor: 5 tokens before any traffic, then denied
+            grants = [proxy.take_hedge_token() for _ in range(6)]
+            assert grants == [True] * 5 + [False]
+            proxy._fwd_requests = 1000  # 10% of 1000 = 100 allowed
+            assert proxy.take_hedge_token()
+            proxy.hedge_budget_pct = 0.0
+            assert not proxy.take_hedge_token()  # 0 disables outright
+        finally:
+            proxy.shutdown()
+            proxy.server_close()
+            a.stop()
+            b.stop()
+
+
+class TestOverloadControl:
+    def test_sheds_with_retry_after_when_saturated(self):
+        backend = _FaultyBackend(delay_s=0.4)
+        proxy, port = _start_proxy([backend], max_concurrent=1,
+                                   max_queue=0, hedge_delay_ms=-1.0)
+        try:
+            results = []
+            lock = threading.Lock()
+
+            def one():
+                try:
+                    status, _ = _proxy_predict(port)
+                    with lock:
+                        results.append((status, None))
+                except urllib.error.HTTPError as e:
+                    with lock:
+                        results.append((e.code,
+                                        e.headers.get("Retry-After")))
+
+            threads = [threading.Thread(target=one) for _ in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            codes = sorted(r[0] for r in results)
+            assert codes[0] == 200  # the admitted request completes
+            assert 503 in codes  # the overflow is shed, not queued
+            assert all(ra == "1" for code, ra in results if code == 503)
+            st = _proxy_stats(port)
+            assert st["overload"]["shed"] >= 1
+            assert st["overload"]["max_concurrent"] == 1
+        finally:
+            proxy.shutdown()
+            proxy.server_close()
+            backend.stop()
+
+    def test_bounded_queue_admits_within_deadline(self):
+        backend = _FaultyBackend(delay_s=0.15)
+        proxy, port = _start_proxy([backend], max_concurrent=1,
+                                   max_queue=4, hedge_delay_ms=-1.0)
+        try:
+            results = []
+            lock = threading.Lock()
+
+            def one():
+                status, _ = _proxy_predict(port)
+                with lock:
+                    results.append(status)
+
+            threads = [threading.Thread(target=one) for _ in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert results == [200, 200, 200]  # queued, not shed
+        finally:
+            proxy.shutdown()
+            proxy.server_close()
+            backend.stop()
+
+
+class TestCanaryEjection:
+    def test_dead_canary_is_ejected_not_retimed(self):
+        """A canary that stops answering is ejected like a main-pool
+        backend: the first failure falls back to the pool, and later
+        requests never re-pay the canary connection attempt."""
+        main = _FaultyBackend(version=1)
+        canary = _FaultyBackend(version=9)
+        canary.stop()  # connection refused from now on
+        proxy, port = _start_proxy([main])
+        try:
+            proxy.set_canary(canary.addr, fraction=1.0)
+            status, ver = _proxy_predict(port)
+            assert (status, ver) == (200, "1")  # pool fallback answered
+            assert proxy.canary is not None
+            assert not proxy.canary.healthy  # ejected on the failure
+            t0 = time.monotonic()
+            for _ in range(5):
+                status, ver = _proxy_predict(port)
+                assert (status, ver) == (200, "1")
+            assert time.monotonic() - t0 < 1.0  # no repeated conn cost
+        finally:
+            proxy.shutdown()
+            proxy.server_close()
+            main.stop()
+
+
+class TestTriedSetBound:
+    def test_has_untried_counts_tried_set_not_list_length(self):
+        a, b = _FaultyBackend(), _FaultyBackend()
+        proxy, _ = _start_proxy([a, b])
+        try:
+            assert proxy.has_untried(set())
+            assert proxy.has_untried({a.addr})
+            assert not proxy.has_untried({a.addr, b.addr})
+            # an ejection mid-request shrinks the healthy list; the
+            # bound keyed on the tried set is unaffected by that
+            proxy.eject(proxy.backends[0])
+            assert not proxy.has_untried({b.addr})
+            assert proxy.has_untried({a.addr})
+        finally:
+            proxy.shutdown()
+            proxy.server_close()
+            a.stop()
+            b.stop()
+
+
+# ----------------------------------------------------------------------
+# replica-side fault injection + deadline + staleness (in-process, jax)
+# ----------------------------------------------------------------------
+class TestServerFaultPath:
+    @pytest.fixture()
+    def server(self, binary_booster, tmp_path):
+        from lightgbm_tpu.serve.server import make_server
+
+        bst, X = binary_booster
+        model = PredictorArtifact.from_booster(bst).save(str(tmp_path / "m"))
+        srv = make_server(model, port=0, warmup_max_rows=64,
+                          max_delay_ms=1.0,
+                          registry_dir=str(tmp_path / "reg"),
+                          registry_poll_ms=50.0)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        yield srv, bst, X
+        srv.shutdown()
+        srv.server_close()
+
+    def _post_rows(self, port, rows, headers=()):
+        body = "\n".join(json.dumps(list(map(float, r)))
+                         for r in rows).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict", data=body)
+        for k, v in headers:
+            req.add_header(k, v)
+        return urllib.request.urlopen(req, timeout=30)
+
+    def _post_fault(self, port, spec):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/fault",
+            data=json.dumps({"spec": spec}).encode())
+        return json.loads(urllib.request.urlopen(req, timeout=30).read())
+
+    def test_spent_budget_504s_before_device_work(self, server):
+        srv, _, X = server
+        port = srv.server_address[1]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post_rows(port, X[:2], headers=[("X-Deadline-Ms", "0")])
+        assert ei.value.code == 504
+        assert "deadline" in json.loads(ei.value.read())["error"]
+        # a live budget still answers
+        r = self._post_rows(port, X[:2],
+                            headers=[("X-Deadline-Ms", "5000")])
+        assert r.status == 200
+
+    def test_fault_off_byte_identical_and_compile_neutral(self, server):
+        """delay faults change timing, never bytes; arming/clearing the
+        spec costs zero new XLA compiles on the serving path."""
+        srv, _, X = server
+        port = srv.server_address[1]
+        base = self._post_rows(port, X[:4]).read()
+        c0 = compilewatch.total_compiles()
+        assert self._post_fault(port, "delay:30")["spec"] == "delay:30"
+        t0 = time.monotonic()
+        wounded = self._post_rows(port, X[:4]).read()
+        assert time.monotonic() - t0 >= 0.03
+        assert wounded == base  # byte-identical, just late
+        st = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats", timeout=30).read())
+        assert st["fault"]["spec"] == "delay:30"
+        assert st["fault"]["injected"]["delay"] >= 1
+        assert self._post_fault(port, "")["spec"] == ""
+        assert self._post_rows(port, X[:4]).read() == base
+        st = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats", timeout=30).read())
+        assert "fault" not in st  # disarmed spec leaves no block
+        assert compilewatch.total_compiles() == c0
+
+    def test_error_fault_counts_and_bad_spec_400(self, server):
+        srv, _, X = server
+        port = srv.server_address[1]
+        listing = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/fault", timeout=30).read())
+        assert listing["spec"] == ""
+        self._post_fault(port, "error:1")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post_rows(port, X[:2])
+        assert ei.value.code == 500
+        assert "injected" in json.loads(ei.value.read())["error"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post_fault(port, "bogus:1")
+        assert ei.value.code == 400
+        self._post_fault(port, "")
+        assert self._post_rows(port, X[:2]).status == 200
+
+    def test_registry_staleness_rises_and_recovers(self, server):
+        srv, _, _ = server
+        port = srv.server_address[1]
+        assert srv.registry_stale_seconds() == 0.0
+        srv._registry_sync_failed(RuntimeError("disk gone"))
+        time.sleep(0.05)
+        s1 = srv.registry_stale_seconds()
+        assert s1 > 0.0
+        time.sleep(0.05)
+        assert srv.registry_stale_seconds() > s1  # a clock, not a flag
+        st = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats", timeout=30).read())
+        assert st["registry"]["stale_seconds"] > 0.0
+        assert st["registry"]["consecutive_failures"] >= 1
+        srv._registry_sync_ok()
+        assert srv.registry_stale_seconds() == 0.0
+        st = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats", timeout=30).read())
+        assert st["registry"]["stale_seconds"] == 0.0
+        assert st["registry"]["consecutive_failures"] == 0
+
+
+# ----------------------------------------------------------------------
+# factory refuses to promote against a stale fleet
+# ----------------------------------------------------------------------
+class _CannedJSON:
+    """One-trick HTTP server: canned JSON per path (a fake proxy or a
+    fake replica, as seen by the factory's freshness gate)."""
+
+    def __init__(self, pages):
+        canned = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                obj = canned.pages.get(self.path)
+                body = json.dumps(obj or {}).encode()
+                self.send_response(200 if obj is not None else 404)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.pages = pages
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self.addr = f"127.0.0.1:{self.port}"
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class TestFactoryFleetFreshnessGate:
+    def _supervisor(self, tmp_path, proxy):
+        from lightgbm_tpu.factory.supervisor import FactorySupervisor
+
+        return FactorySupervisor(
+            data_dir=str(tmp_path / "data"),
+            workdir=str(tmp_path / "work"),
+            registry_dir=str(tmp_path / "reg"),
+            proxy=proxy, max_registry_stale_s=30.0)
+
+    def test_refuses_promotion_against_stale_fleet(self, tmp_path):
+        replica = _CannedJSON(
+            {"/stats": {"registry": {"stale_seconds": 120.0}}})
+        proxy = _CannedJSON({"/fleet/stats": {"backends": [
+            {"addr": replica.addr, "healthy": True}]}})
+        try:
+            sup = self._supervisor(tmp_path, proxy.addr)
+            ok, detail = sup._fleet_fresh()
+            assert not ok
+            fl = detail["fleet"]
+            assert "staleness" in fl["reason"]
+            assert fl["stale_backends"] == {replica.addr: 120.0}
+            assert fl["max_stale_s"] == 120.0
+        finally:
+            replica.stop()
+            proxy.stop()
+
+    def test_fresh_fleet_passes(self, tmp_path):
+        replica = _CannedJSON(
+            {"/stats": {"registry": {"stale_seconds": 0.0}}})
+        proxy = _CannedJSON({"/fleet/stats": {"backends": [
+            {"addr": replica.addr, "healthy": True},
+            {"addr": "127.0.0.1:9", "healthy": False},  # prober's problem
+        ]}})
+        try:
+            sup = self._supervisor(tmp_path, proxy.addr)
+            ok, detail = sup._fleet_fresh()
+            assert ok
+            assert detail["fleet"]["max_stale_s"] == 0.0
+        finally:
+            replica.stop()
+            proxy.stop()
+
+    def test_unreadable_proxy_refuses(self, tmp_path):
+        sup = self._supervisor(tmp_path, "127.0.0.1:9")  # nothing there
+        ok, detail = sup._fleet_fresh()
+        assert not ok
+        assert "cannot read fleet stats" in detail["fleet"]["reason"]
+
+
+# ----------------------------------------------------------------------
+# the chaos harness: a wounded fleet under live closed-loop traffic
+# ----------------------------------------------------------------------
+def _spawn_fleet(registry_dir, n):
+    from lightgbm_tpu.serve.fleet import _wait_ready, spawn_replicas
+
+    procs = spawn_replicas(n, {
+        "registry": registry_dir,
+        "warmup_max_rows": "64",
+        "max_delay_ms": "1",
+        "registry_poll_ms": "100",
+    })
+    try:
+        for _, port in procs:
+            assert _wait_ready("127.0.0.1", port, 120.0), \
+                f"replica on port {port} never became ready"
+    except BaseException:
+        for p, _ in procs:
+            p.kill()
+        raise
+    return procs
+
+
+def _arm_fault(port, spec):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/fault",
+        data=json.dumps({"spec": spec}).encode())
+    reply = json.loads(urllib.request.urlopen(req, timeout=30).read())
+    assert reply["spec"] == spec
+
+
+def _deadline_loop(port, rows, expected, duration_s, n_threads=4,
+                   deadline_ms=8000):
+    """Closed-loop traffic with an X-Deadline-Ms budget on every
+    request; every reply must be 200 and stamped with exactly one KNOWN
+    version whose predictions it matches."""
+    body = "\n".join(json.dumps(list(map(float, r))) for r in rows).encode()
+    stop = time.monotonic() + duration_s
+    lock = threading.Lock()
+    stats = {"n": 0, "errors": [], "versions": set(), "lat": []}
+
+    def worker():
+        while time.monotonic() < stop:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict?model_version=1",
+                data=body)
+            req.add_header("X-Deadline-Ms", str(deadline_ms))
+            t0 = time.perf_counter()
+            try:
+                r = urllib.request.urlopen(req, timeout=60)
+                lines = [json.loads(l)
+                         for l in r.read().decode().splitlines()]
+            except Exception as e:
+                with lock:
+                    stats["errors"].append(f"{type(e).__name__}: {e}")
+                continue
+            lat = time.perf_counter() - t0
+            vers = {l["model_version"] for l in lines}
+            err = None
+            if len(vers) != 1:
+                err = f"reply mixed versions {vers}"
+            else:
+                ver = vers.pop()
+                if ver not in expected:
+                    err = f"unknown version {ver}"
+                elif not np.allclose([l["prediction"] for l in lines],
+                                     expected[ver]):
+                    err = f"v{ver} reply does not match v{ver} model"
+            with lock:
+                stats["n"] += 1
+                stats["lat"].append(lat)
+                if err:
+                    stats["errors"].append(err)
+                else:
+                    stats["versions"].add(ver)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    return threads, stats
+
+
+def _p99(lats):
+    vals = sorted(lats)
+    return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
+
+
+def _backend_breaker(proxy, addr):
+    for b in proxy.stats()["backends"]:
+        if b["addr"] == addr:
+            return b["breaker"]
+    raise AssertionError(f"{addr} not in fleet stats")
+
+
+@pytest.mark.servefault
+class TestServeChaosSmoke:
+    """Tier-1 chaos: 3 subprocess replicas behind the hardened proxy;
+    one replica hung (accepts connections, /readyz green, /predict
+    never answers), one delay-injected, one SIGKILLed — all under live
+    closed-loop deadline-carrying traffic."""
+
+    def test_fleet_survives_hang_delay_and_kill(self, binary_booster,
+                                                tmp_path):
+        bst, X = binary_booster
+        art = PredictorArtifact.from_booster(bst)
+        rows = X[:2]
+        expected = {1: PackedPredictor(art).predict(rows)}
+        reg_dir = str(tmp_path / "reg")
+        ModelRegistry(reg_dir).publish(art)
+
+        procs = _spawn_fleet(reg_dir, n=3)
+        ports = [p for _, p in procs]
+        addrs = [f"127.0.0.1:{p}" for p in ports]
+        proxy = FleetProxy(("127.0.0.1", 0), addrs,
+                           health_poll_s=0.2, retry_deadline_s=20.0,
+                           backend_timeout_s=2.0,
+                           hedge_delay_ms=60.0, hedge_budget_pct=100.0,
+                           breaker_k=3.0, breaker_m=2,
+                           breaker_open_ms=1000.0)
+        threading.Thread(target=proxy.serve_forever, daemon=True).start()
+        port = proxy.server_address[1]
+        try:
+            # -- healthy baseline on the very fleet we are about to wound
+            threads, base = _deadline_loop(port, rows, expected,
+                                           duration_s=2.0)
+            for t in threads:
+                t.join(timeout=60)
+            assert base["errors"] == [], base["errors"][:5]
+            assert base["n"] > 0
+            healthy_p99 = _p99(base["lat"])
+
+            # -- wound it: replica 0 hangs every predict, replica 1
+            # delays every predict; replica 2 will be SIGKILLed mid-run
+            _arm_fault(ports[0], "hang:1")
+            _arm_fault(ports[1], "delay:150")
+            threads, chaos = _deadline_loop(port, rows, expected,
+                                            duration_s=8.0)
+            time.sleep(2.5)
+            procs[2][0].send_signal(signal.SIGKILL)
+            for t in threads:
+                t.join(timeout=120)
+
+            # zero dropped, zero mis-versioned
+            assert chaos["errors"] == [], chaos["errors"][:5]
+            assert chaos["n"] > 0
+            assert chaos["versions"] == {1}
+            # bounded tail: well under the backend socket timeout and
+            # the 8 s client budget even with every replica wounded
+            chaos_p99 = _p99(chaos["lat"])
+            assert chaos_p99 < max(3.0 * healthy_p99, 1.2), \
+                f"chaos p99 {chaos_p99:.3f}s vs healthy {healthy_p99:.3f}s"
+            assert chaos_p99 < proxy.backend_timeout_s
+            st = proxy.stats()
+            assert st["hedges"]["launched"] >= 1  # hedges did the rescue
+            # the hung replica's breaker tripped on its timeout streak
+            assert _backend_breaker(proxy, addrs[0])["opens"] >= 1
+
+            # -- clear the faults; the half-open probe must restore the
+            # hung replica to CLOSED under ordinary traffic
+            time.sleep(2.5)  # let straggler attempts time out and drain
+            _arm_fault(ports[0], "")
+            _arm_fault(ports[1], "")
+            body = "\n".join(json.dumps(list(map(float, r)))
+                             for r in rows).encode()
+            deadline = time.monotonic() + 15.0
+            state = None
+            while time.monotonic() < deadline:
+                for _ in range(4):
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{port}/predict", data=body)
+                    req.add_header("X-Deadline-Ms", "8000")
+                    try:
+                        urllib.request.urlopen(req, timeout=60).read()
+                    except urllib.error.HTTPError:
+                        pass  # routing noise while the fleet settles
+                state = _backend_breaker(proxy, addrs[0])["state"]
+                if state == breaker_mod.CLOSED:
+                    break
+                time.sleep(0.2)
+            assert state == breaker_mod.CLOSED, \
+                f"breaker never re-closed (state={state})"
+        finally:
+            proxy.shutdown()
+            proxy.server_close()
+            for p, _ in procs:
+                p.kill()
+                p.wait(timeout=30)
+
+
+@pytest.mark.servefault
+@pytest.mark.slow
+class TestSustainedChaosMatrix:
+    """Sustained wounded-fleet soak: a flapping replica (alternating
+    hang/healthy phases) plus a fractionally-delayed replica for 12 s of
+    closed-loop deadline traffic — zero client-visible failures and a
+    tail bounded by the backend timeout throughout."""
+
+    def test_flap_and_fractional_delay_soak(self, binary_booster,
+                                            tmp_path):
+        bst, X = binary_booster
+        art = PredictorArtifact.from_booster(bst)
+        rows = X[:2]
+        expected = {1: PackedPredictor(art).predict(rows)}
+        reg_dir = str(tmp_path / "reg")
+        ModelRegistry(reg_dir).publish(art)
+
+        procs = _spawn_fleet(reg_dir, n=3)
+        ports = [p for _, p in procs]
+        proxy = FleetProxy(("127.0.0.1", 0),
+                           [f"127.0.0.1:{p}" for p in ports],
+                           health_poll_s=0.2, retry_deadline_s=20.0,
+                           backend_timeout_s=2.0,
+                           hedge_delay_ms=60.0, hedge_budget_pct=100.0,
+                           breaker_k=3.0, breaker_m=2,
+                           breaker_open_ms=1000.0)
+        threading.Thread(target=proxy.serve_forever, daemon=True).start()
+        port = proxy.server_address[1]
+        try:
+            _arm_fault(ports[0], "flap:1")
+            _arm_fault(ports[1], "delay:300:0.5")
+            threads, stats = _deadline_loop(port, rows, expected,
+                                            duration_s=12.0)
+            for t in threads:
+                t.join(timeout=120)
+            assert stats["errors"] == [], stats["errors"][:5]
+            assert stats["n"] > 0
+            assert stats["versions"] == {1}
+            assert _p99(stats["lat"]) < proxy.backend_timeout_s
+            # both wounds really fired on the replicas
+            for p, kind in ((ports[0], "hang"), (ports[1], "delay")):
+                c = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{p}/fault", timeout=30).read())
+                assert c["injected"].get(kind, 0) >= 1
+        finally:
+            proxy.shutdown()
+            proxy.server_close()
+            for p, _ in procs:
+                p.kill()
+                p.wait(timeout=30)
